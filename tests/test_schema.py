@@ -1,0 +1,79 @@
+import numpy as np
+
+from theia_tpu.schema import (
+    FLOW_SCHEMA, FLOW_COLUMNS, STRING_COLUMNS, ColumnarBatch,
+    StringDictionary)
+from theia_tpu.data import SynthConfig, generate_flows
+
+
+def test_flow_schema_column_count():
+    # 52 columns, matching the reference flows_local DDL
+    # (create_table.sh:31-84).
+    assert len(FLOW_SCHEMA) == 52
+    assert FLOW_COLUMNS[0] == "timeInserted"
+    assert FLOW_COLUMNS[-1] == "trusted"
+    assert "sourcePodLabels" in STRING_COLUMNS
+    assert "throughput" not in STRING_COLUMNS
+
+
+def test_string_dictionary_roundtrip():
+    d = StringDictionary()
+    codes = d.encode(["a", "b", "a", "", "c"])
+    assert codes.dtype == np.int32
+    assert codes[0] == codes[2]
+    assert codes[3] == 0  # empty string is always code 0
+    assert list(d.decode(codes)) == ["a", "b", "a", "", "c"]
+    assert d.lookup("zzz") is None
+    assert d.lookup("b") == codes[1]
+
+
+def test_columnar_batch_from_rows_and_ops():
+    rows = [
+        {"id": "x", "type": "initial", "timeCreated": 5, "policy": "p",
+         "kind": "K8sNetworkPolicy"},
+        {"id": "y", "type": "subsequent", "timeCreated": 9, "policy": "q",
+         "kind": "AntreaNetworkPolicy"},
+    ]
+    from theia_tpu.schema import RECOMMENDATIONS_SCHEMA
+    b = ColumnarBatch.from_rows(rows, RECOMMENDATIONS_SCHEMA)
+    assert len(b) == 2
+    assert list(b.strings("id")) == ["x", "y"]
+    f = b.filter(b["timeCreated"] > 6)
+    assert len(f) == 1 and f.strings("id")[0] == "y"
+    back = b.to_rows()
+    assert back[0]["policy"] == "p"
+    c = ColumnarBatch.concat([b, f])
+    assert len(c) == 3
+
+
+def test_synth_generator_schema_and_series():
+    cfg = SynthConfig(n_series=32, points_per_series=20, anomaly_fraction=0.25)
+    batch = generate_flows(cfg)
+    assert len(batch) == 32 * 20
+    assert set(batch.column_names) == set(FLOW_COLUMNS)
+    # throughput positive, flowEndSeconds increasing within a series
+    assert (batch["throughput"] > 0).all()
+    fe = batch["flowEndSeconds"].reshape(32, 20)
+    assert (np.diff(fe, axis=1) > 0).all()
+    # anomalous series contain a spike well above base
+    gt = batch.ground_truth_anomalous
+    assert gt.any()
+    tp = batch["throughput"].reshape(32, 20).astype(float)
+    ratios = tp.max(axis=1) / np.median(tp, axis=1)
+    assert (ratios[gt] > 5).all()
+    # deterministic
+    batch2 = generate_flows(cfg)
+    np.testing.assert_array_equal(batch["throughput"], batch2["throughput"])
+
+
+def test_synth_flow_types_and_service_fields():
+    cfg = SynthConfig(n_series=200, points_per_series=2, seed=7)
+    b = generate_flows(cfg)
+    ft = b["flowType"]
+    assert set(np.unique(ft)) <= {1, 2, 3}
+    # external flows have empty destination pod
+    ext = ft == 3
+    dst_pod = b.strings("destinationPodName")
+    assert all(p == "" for p in dst_pod[ext])
+    svc = b.strings("destinationServicePortName")
+    assert any(s != "" for s in svc)

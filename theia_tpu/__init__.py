@@ -1,0 +1,23 @@
+"""theia_tpu — a TPU-native network observability & analytics framework.
+
+Re-implements the capabilities of antrea-io/theia (Kubernetes network flow
+observability: flow store, Grafana dashboards, NetworkPolicy recommendation,
+throughput anomaly detection) with a JAX/XLA/Pallas compute tier designed for
+TPU, instead of the reference's Spark/JVM batch tier.
+
+Subpackages:
+  schema    — the 46+-column Antrea flow record schema and columnar encoding
+  store     — in-memory columnar flow store with materialized views, TTL,
+              retention monitoring and versioned schema migration
+  ingest    — native (C++) and pure-python ingest paths into columnar blocks
+  ops       — on-device kernels: EWMA/ARIMA/DBSCAN, segment reductions,
+              sketches (Count-Min), online k-means
+  analytics — the TAD and NPR jobs (reference: plugins/anomaly-detection,
+              plugins/policy-recommendation)
+  parallel  — device meshes, sharded scoring, sequence-parallel scans
+  runner    — the tpu-job-runner with the reference Spark-job CLI contract
+  manager   — control plane: REST API groups + job controllers
+  cli       — the `theia` command line interface
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+from .synth import SynthConfig, generate_flows, DEFAULT_START  # noqa: F401

@@ -1,0 +1,11 @@
+from .flow_schema import (  # noqa: F401
+    Column,
+    ColumnKind,
+    FLOW_SCHEMA,
+    FLOW_COLUMNS,
+    STRING_COLUMNS,
+    NUMERIC_COLUMNS,
+    TADETECTOR_SCHEMA,
+    RECOMMENDATIONS_SCHEMA,
+)
+from .columnar import StringDictionary, ColumnarBatch  # noqa: F401

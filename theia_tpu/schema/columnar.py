@@ -1,0 +1,163 @@
+"""Columnar batch representation: struct-of-arrays with dictionary-encoded
+strings.
+
+A `ColumnarBatch` is the unit of data movement through the framework: the
+ingest path produces them, the store accumulates them, and the analytics jobs
+slice/stack them into device tensors. All columns are fixed-width numpy
+arrays of equal length, so a batch (or any column subset of it) can be
+`jax.device_put` without copies or Python-object traversal.
+
+The reference moves rows as ClickHouse result sets / Spark DataFrames; here
+the equivalent contract is "int32 codes + per-column StringDictionary"
+(reference behavior: string group-bys over e.g. sourcePodLabels in
+plugins/anomaly-detection/anomaly_detection.py:118-137 and
+plugins/policy-recommendation/policy_recommendation_job.py map steps).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Mapping, Optional, Sequence
+
+import numpy as np
+
+
+class StringDictionary:
+    """Append-only string↔int32 dictionary.
+
+    Code 0 is always the empty string, matching ClickHouse's String default
+    and the reference's pervasive `== ''` predicates (e.g. the unprotected
+    flow filter in policy_recommendation_job.py:785-802).
+    """
+
+    __slots__ = ("_to_code", "_strings")
+
+    def __init__(self) -> None:
+        self._to_code: Dict[str, int] = {"": 0}
+        self._strings: List[str] = [""]
+
+    def __len__(self) -> int:
+        return len(self._strings)
+
+    def encode_one(self, s: str) -> int:
+        code = self._to_code.get(s)
+        if code is None:
+            code = len(self._strings)
+            self._to_code[s] = code
+            self._strings.append(s)
+        return code
+
+    def encode(self, values: Sequence[str]) -> np.ndarray:
+        """Vectorized encode: dedupe first so the Python loop only touches
+        unique values (cheap for the low-cardinality k8s-identity columns)."""
+        arr = np.asarray(values, dtype=object)
+        uniques, inverse = np.unique(arr, return_inverse=True)
+        codes_for_uniques = np.fromiter(
+            (self.encode_one(u) for u in uniques), dtype=np.int32,
+            count=len(uniques))
+        return codes_for_uniques[inverse].astype(np.int32)
+
+    def decode_one(self, code: int) -> str:
+        return self._strings[int(code)]
+
+    def decode(self, codes: np.ndarray) -> np.ndarray:
+        table = np.asarray(self._strings, dtype=object)
+        return table[np.asarray(codes, dtype=np.int64)]
+
+    def lookup(self, s: str) -> Optional[int]:
+        """Code for `s` if present, else None (never allocates)."""
+        return self._to_code.get(s)
+
+
+class ColumnarBatch:
+    """Equal-length struct-of-arrays with an associated dictionary set.
+
+    `dicts` maps string-column name → StringDictionary used to encode that
+    column. Dictionaries are shared by reference (typically owned by the
+    FlowStore) so codes are comparable across batches.
+    """
+
+    def __init__(self, columns: Mapping[str, np.ndarray],
+                 dicts: Optional[Mapping[str, StringDictionary]] = None):
+        lengths = {len(v) for v in columns.values()}
+        if len(lengths) > 1:
+            raise ValueError(f"ragged columns: lengths {sorted(lengths)}")
+        self.columns: Dict[str, np.ndarray] = dict(columns)
+        self.dicts: Dict[str, StringDictionary] = dict(dicts or {})
+
+    def __len__(self) -> int:
+        if not self.columns:
+            return 0
+        return len(next(iter(self.columns.values())))
+
+    def __getitem__(self, name: str) -> np.ndarray:
+        return self.columns[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.columns
+
+    @property
+    def column_names(self) -> Iterable[str]:
+        return self.columns.keys()
+
+    def strings(self, name: str) -> np.ndarray:
+        """Decode a dictionary-encoded column back to python strings."""
+        return self.dicts[name].decode(self.columns[name])
+
+    def take(self, indices: np.ndarray) -> "ColumnarBatch":
+        return ColumnarBatch(
+            {k: v[indices] for k, v in self.columns.items()}, self.dicts)
+
+    def filter(self, mask: np.ndarray) -> "ColumnarBatch":
+        return ColumnarBatch(
+            {k: v[mask] for k, v in self.columns.items()}, self.dicts)
+
+    def select(self, names: Sequence[str]) -> "ColumnarBatch":
+        return ColumnarBatch({n: self.columns[n] for n in names},
+                             {n: d for n, d in self.dicts.items()
+                              if n in names})
+
+    @staticmethod
+    def concat(batches: Sequence["ColumnarBatch"]) -> "ColumnarBatch":
+        if not batches:
+            return ColumnarBatch({})
+        names = list(batches[0].column_names)
+        dicts: Dict[str, StringDictionary] = {}
+        for b in batches:
+            dicts.update(b.dicts)
+        return ColumnarBatch(
+            {n: np.concatenate([b[n] for b in batches]) for n in names},
+            dicts)
+
+    @staticmethod
+    def from_rows(rows: Sequence[Mapping[str, object]], schema,
+                  dicts: Optional[Mapping[str, StringDictionary]] = None
+                  ) -> "ColumnarBatch":
+        """Build a batch from row dicts against a schema (tuple of Column).
+
+        Missing values take the column default (0 / empty string)."""
+        dicts = dict(dicts or {})
+        cols: Dict[str, np.ndarray] = {}
+        for col in schema:
+            if col.is_string:
+                d = dicts.setdefault(col.name, StringDictionary())
+                values = [str(r.get(col.name, "")) for r in rows]
+                cols[col.name] = d.encode(values) if rows else np.zeros(
+                    0, np.int32)
+            else:
+                cols[col.name] = np.asarray(
+                    [r.get(col.name, 0) for r in rows], dtype=col.host_dtype)
+        return ColumnarBatch(cols, dicts)
+
+    def to_rows(self, schema=None) -> List[Dict[str, object]]:
+        """Materialize python row dicts (decoding strings). Test/CLI helper —
+        not a hot path."""
+        names = list(self.column_names)
+        decoded = {
+            n: (self.strings(n) if n in self.dicts else self.columns[n])
+            for n in names}
+        out = []
+        for i in range(len(self)):
+            out.append({n: (decoded[n][i].item()
+                            if isinstance(decoded[n][i], np.generic)
+                            else decoded[n][i]) for n in names})
+        return out
