@@ -1,0 +1,164 @@
+"""End-to-end TAD: store → series tensorization → scoring → result rows.
+
+Mirrors the reference job's behaviors (anomaly_detection.py): series
+construction per agg mode (:507-710), spike recovery, filler row
+(:395-420), ns-ignore and time filters.
+"""
+
+import numpy as np
+import pytest
+
+from theia_tpu.analytics import TadQuerySpec, build_series, run_tad
+from theia_tpu.data.synth import SynthConfig, generate_flows
+from theia_tpu.store import FlowDatabase
+
+
+def make_db(**kw):
+    cfg = SynthConfig(**kw)
+    batch = generate_flows(cfg)
+    db = FlowDatabase()
+    db.insert_flows(batch)
+    return db, batch, cfg
+
+
+def test_series_construction_connection_mode():
+    db, batch, cfg = make_db(n_series=16, points_per_series=12)
+    series = build_series(db.flows.scan(), TadQuerySpec())
+    assert series.n_series == cfg.n_series
+    assert series.values.shape == (16, 12)
+    assert series.mask.all()
+    # every series' values match the synthetic throughput for its key
+    thr = batch["throughput"].reshape(16, 12)
+    # match series by (sourceIP, sourceTransportPort)
+    sip = batch.strings("sourceIP").reshape(16, 12)[:, 0]
+    sport = batch["sourceTransportPort"].reshape(16, 12)[:, 0]
+    lookup = {(ip, p): i for i, (ip, p) in enumerate(zip(sip, sport))}
+    for s in range(series.n_series):
+        key = (series.keys["sourceIP"][s],
+               int(series.keys["sourceTransportPort"][s]))
+        np.testing.assert_array_equal(
+            series.values[s], thr[lookup[key]].astype(float))
+    # times are sorted within each series
+    assert (np.diff(series.times, axis=1) >= 0).all()
+
+
+def test_series_max_aggregation_on_duplicate_timestamps():
+    db, batch, cfg = make_db(n_series=4, points_per_series=6)
+    db.insert_flows(batch)  # same timestamps again → max() must dedupe
+    series = build_series(db.flows.scan(), TadQuerySpec())
+    assert series.n_series == cfg.n_series
+    assert series.values.shape == (4, 6)  # not 12: same flowEndSeconds
+
+
+def test_series_pod_mode_directions():
+    db, batch, _ = make_db(n_series=12, points_per_series=5)
+    series = build_series(
+        db.flows.scan(), TadQuerySpec(agg_flow="pod"))
+    assert series.agg_type == "pod"
+    dirs = set(series.keys["direction"])
+    assert dirs <= {"inbound", "outbound"} and len(dirs) == 2
+    # labels are canonical JSON (meaningless labels removed)
+    for s in series.keys["podLabels"]:
+        assert s.startswith("{") and "pod-template-hash" not in s
+
+
+def test_series_pod_label_filter_matches_substring():
+    db, batch, _ = make_db(n_series=12, points_per_series=5)
+    all_series = build_series(db.flows.scan(), TadQuerySpec(agg_flow="pod"))
+    some_label = all_series.keys["podLabels"][0]
+    import json
+    needle = json.loads(some_label)["app"]
+    filtered = build_series(
+        db.flows.scan(),
+        TadQuerySpec(agg_flow="pod", pod_label=needle))
+    assert 0 < filtered.n_series <= all_series.n_series
+    assert all(needle in s for s in filtered.keys["podLabels"])
+
+
+def test_series_external_mode():
+    db, batch, _ = make_db(n_series=32, points_per_series=5,
+                           external_fraction=0.4)
+    series = build_series(db.flows.scan(),
+                          TadQuerySpec(agg_flow="external"))
+    assert series.agg_type == "external"
+    assert series.n_series > 0
+    assert all(ip.startswith("203.0.113.") for ip in
+               series.keys["destinationIP"])
+
+
+def test_series_svc_mode():
+    db, batch, _ = make_db(n_series=32, points_per_series=5,
+                           service_fraction=0.5)
+    series = build_series(db.flows.scan(), TadQuerySpec(agg_flow="svc"))
+    assert series.n_series > 0
+    assert all("/svc-" in s for s in
+               series.keys["destinationServicePortName"])
+
+
+def test_series_ns_ignore_list():
+    db, batch, _ = make_db(n_series=32, points_per_series=4)
+    full = build_series(db.flows.scan(), TadQuerySpec())
+    pruned = build_series(
+        db.flows.scan(), TadQuerySpec(ns_ignore_list=["ns-0", "ns-1"]))
+    assert pruned.n_series < full.n_series
+
+
+def test_series_time_window():
+    db, batch, cfg = make_db(n_series=8, points_per_series=20)
+    t0 = int(batch["flowEndSeconds"].min())
+    series = build_series(db.flows.scan(), TadQuerySpec(end_time=t0 + 10))
+    assert series.values.shape[1] == 10
+
+
+@pytest.mark.parametrize("algo", ["EWMA", "ARIMA", "DBSCAN"])
+def test_tad_end_to_end_recovers_ground_truth(algo):
+    # DBSCAN's fixed eps (2.5e8 bytes/s) needs realistically-large
+    # throughput for a spike to leave the base cluster.
+    base = 1e7 if algo == "DBSCAN" else 1e6
+    magnitude = 100.0 if algo == "DBSCAN" else 50.0
+    db, batch, cfg = make_db(
+        n_series=24, points_per_series=40 if algo != "ARIMA" else 24,
+        anomaly_fraction=0.3, anomaly_magnitude=magnitude,
+        base_throughput=base, seed=7)
+    tad_id = run_tad(db, algo, TadQuerySpec(), tad_id="test-job-1")
+    assert tad_id == "test-job-1"
+    result = db.tadetector.scan()
+    rows = result.to_rows()
+    assert all(r["id"] == "test-job-1" for r in rows)
+    assert all(r["algoType"] == algo for r in rows)
+
+    # every ground-truth-anomalous series must be flagged at its spike
+    truth = batch.ground_truth_anomalous
+    sip = batch.strings("sourceIP").reshape(cfg.n_series, -1)[:, 0]
+    sport = batch["sourceTransportPort"].reshape(cfg.n_series, -1)[:, 0]
+    thr = batch["throughput"].reshape(cfg.n_series, -1)
+    flagged = {(r["sourceIP"], r["sourceTransportPort"],
+                int(r["throughput"])) for r in rows}
+    for i in np.nonzero(truth)[0]:
+        spike_val = int(thr[i].max())
+        assert (sip[i], int(sport[i]), spike_val) in flagged, (
+            f"{algo} missed ground-truth spike in series {i}")
+
+
+def test_tad_no_anomaly_filler_row():
+    db = FlowDatabase()
+    run_tad(db, "EWMA", TadQuerySpec(), tad_id="empty-1", now=12345)
+    rows = db.tadetector.scan().to_rows()
+    assert len(rows) == 1
+    r = rows[0]
+    assert r["anomaly"] == "NO ANOMALY DETECTED"
+    assert r["sourceIP"] == "None" and r["aggType"] == "None"
+    assert r["flowStartSeconds"] == 12345 and r["id"] == "empty-1"
+
+
+def test_tad_agg_pod_end_to_end():
+    db, batch, cfg = make_db(
+        n_series=16, points_per_series=30, anomaly_fraction=0.25,
+        anomaly_magnitude=60.0, seed=3)
+    run_tad(db, "EWMA", TadQuerySpec(agg_flow="pod"), tad_id="pod-1")
+    rows = db.tadetector.scan().to_rows()
+    real = [r for r in rows if r["anomaly"] == "true"]
+    assert real, "expected pod-aggregated anomalies"
+    assert all(r["aggType"] == "pod" for r in real)
+    assert all(r["direction"] in ("inbound", "outbound") for r in real)
+    assert all(r["podLabels"].startswith("{") for r in real)

@@ -1,23 +1,28 @@
 """theia_tpu — a TPU-native network observability & analytics framework.
 
 Re-implements the capabilities of antrea-io/theia (Kubernetes network flow
-observability: flow store, Grafana dashboards, NetworkPolicy recommendation,
-throughput anomaly detection) with a JAX/XLA/Pallas compute tier designed for
-TPU, instead of the reference's Spark/JVM batch tier.
+observability: flow store, NetworkPolicy recommendation, throughput anomaly
+detection, manager REST API, `theia` CLI) with a JAX/XLA compute tier
+designed for TPU, instead of the reference's Spark/JVM batch tier.
 
 Subpackages:
-  schema    — the 46+-column Antrea flow record schema and columnar encoding
-  store     — in-memory columnar flow store with materialized views, TTL,
-              retention monitoring and versioned schema migration
-  ingest    — native (C++) and pure-python ingest paths into columnar blocks
-  ops       — on-device kernels: EWMA/ARIMA/DBSCAN, segment reductions,
-              sketches (Count-Min), online k-means
+  schema    — the 52-column Antrea flow record schema and columnar encoding
+  store     — in-memory columnar flow database: flows + result tables,
+              materialized views (pod/node/policy), TTL eviction, retention
+              monitor, save/load persistence
+  data      — synthetic Antrea flow generator (benchmarks + tests)
+  ops       — on-device kernels: EWMA/ARIMA/DBSCAN anomaly scoring,
+              masked segment/series statistics
   analytics — the TAD and NPR jobs (reference: plugins/anomaly-detection,
               plugins/policy-recommendation)
-  parallel  — device meshes, sharded scoring, sequence-parallel scans
-  runner    — the tpu-job-runner with the reference Spark-job CLI contract
-  manager   — control plane: REST API groups + job controllers
+  parallel  — device meshes and sharded scoring (shard_map over series)
+  runner    — the tpu-job-runner honoring the reference Spark-job CLI
+              contract, with progress reporting
+  manager   — control plane: intelligence/stats API + job controller state
+              machine (NEW→SCHEDULED→RUNNING→COMPLETED/FAILED)
   cli       — the `theia` command line interface
+  ingest    — ingest paths into columnar blocks
+  utils     — shared helpers
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"

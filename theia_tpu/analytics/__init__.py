@@ -1,0 +1,9 @@
+"""Analytics jobs: throughput anomaly detection + policy recommendation."""
+
+from .series import SeriesBatch, TadQuerySpec, build_series
+from .tad import ALGORITHMS, detect_anomalies, run_tad, score_series
+
+__all__ = [
+    "SeriesBatch", "TadQuerySpec", "build_series",
+    "ALGORITHMS", "detect_anomalies", "run_tad", "score_series",
+]

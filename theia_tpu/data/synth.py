@@ -190,13 +190,15 @@ def generate_flows(cfg: SynthConfig,
         "sourceTransportPort": rep(src_port),
         "destinationTransportPort": rep(dst_port),
         "protocolIdentifier": rep(proto),
-        "packetTotalCount": np.maximum(octet_delta.ravel() // 1400, 1),
+        "packetTotalCount": np.cumsum(
+            np.maximum(octet_delta // 1400, 1), axis=1).ravel(),
         "octetTotalCount": np.cumsum(octet_delta, axis=1).ravel(),
         "packetDeltaCount": np.maximum(octet_delta.ravel() // 1400, 1),
         "octetDeltaCount": octet_delta.ravel(),
-        "reversePacketTotalCount": np.maximum(
-            octet_delta.ravel() // 28000, 1),
-        "reverseOctetTotalCount": octet_delta.ravel() // 20,
+        "reversePacketTotalCount": np.cumsum(
+            np.maximum(octet_delta // 28000, 1), axis=1).ravel(),
+        "reverseOctetTotalCount": np.cumsum(
+            octet_delta // 20, axis=1).ravel(),
         "reversePacketDeltaCount": np.maximum(
             octet_delta.ravel() // 28000, 1),
         "reverseOctetDeltaCount": octet_delta.ravel() // 20,

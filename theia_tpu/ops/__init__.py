@@ -1,0 +1,13 @@
+"""On-device kernels: anomaly scoring + masked series statistics."""
+
+from .arima import arima_scores, arima_walk_forward, boxcox_lambda
+from .dbscan import dbscan_noise, dbscan_scores
+from .ewma import ewma, ewma_scores
+from .masked import masked_count, masked_mean, masked_stddev_samp
+
+__all__ = [
+    "arima_scores", "arima_walk_forward", "boxcox_lambda",
+    "dbscan_noise", "dbscan_scores",
+    "ewma", "ewma_scores",
+    "masked_count", "masked_mean", "masked_stddev_samp",
+]

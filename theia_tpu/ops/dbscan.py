@@ -1,0 +1,57 @@
+"""DBSCAN outlier scoring as dense pairwise-distance matrix ops.
+
+Reference semantics (plugins/anomaly-detection/anomaly_detection.py:325-349):
+sklearn DBSCAN(min_samples=4, eps=2.5e8) over the 1-D throughput values of
+one connection; points labeled -1 (noise) are anomalies. The algoCalc
+column is a 0.0 placeholder (:312-322).
+
+TPU-first design: general DBSCAN's cluster expansion is data-dependent
+control flow, but *noise detection* — all the job needs — is closed-form:
+
+    core_i   = |{j : |x_i − x_j| ≤ eps}| ≥ min_samples   (self included)
+    noise_i  = ¬core_i ∧ ¬∃j (core_j ∧ |x_i − x_j| ≤ eps)
+
+i.e. a point is noise iff it is neither a core point nor within eps of
+one. That is exactly sklearn's label==-1 set, computed as one [T,T]
+masked distance matrix per series — batched matmul-shaped work instead of
+sequential region growing.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_EPS = 2.5e8
+DEFAULT_MIN_SAMPLES = 4
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "min_samples"))
+def dbscan_noise(x: jnp.ndarray, mask: jnp.ndarray,
+                 eps: float = DEFAULT_EPS,
+                 min_samples: int = DEFAULT_MIN_SAMPLES) -> jnp.ndarray:
+    """Noise (= anomaly) flags for a padded [S, T] series batch."""
+    within = (jnp.abs(x[..., :, None] - x[..., None, :]) <= eps)
+    pair_valid = mask[..., :, None] & mask[..., None, :]
+    within &= pair_valid
+    neighbor_counts = jnp.sum(within, axis=-1)
+    core = (neighbor_counts >= min_samples) & mask
+    reachable = jnp.any(within & core[..., None, :], axis=-1)
+    return mask & ~core & ~reachable
+
+
+def dbscan_scores(x: jnp.ndarray, mask: jnp.ndarray,
+                  eps: float = DEFAULT_EPS,
+                  min_samples: int = DEFAULT_MIN_SAMPLES):
+    """(algoCalc placeholder zeros, stddev, anomaly) for DBSCAN.
+
+    stddev is still emitted to fill the tadetector row shape (the
+    reference computes it in the groupby regardless of algorithm).
+    """
+    from .masked import masked_stddev_samp
+    anomaly = dbscan_noise(x, mask, eps=eps, min_samples=min_samples)
+    calc = jnp.zeros_like(x)
+    std = masked_stddev_samp(x, mask)
+    return calc, std, anomaly

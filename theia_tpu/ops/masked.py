@@ -1,0 +1,32 @@
+"""Masked per-series statistics over padded [S, T] tensors.
+
+The analytics jobs batch ragged per-connection time series into padded
+tensors with a validity mask; every statistic here honors the mask so the
+padding never leaks into results. Sample standard deviation matches Spark's
+`stddev_samp` (reference: plugins/anomaly-detection/anomaly_detection.py:
+676-684) including its NULL-for-n<2 behavior (we return NaN).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def masked_count(mask: jnp.ndarray) -> jnp.ndarray:
+    return jnp.sum(mask.astype(jnp.int32), axis=-1)
+
+
+def masked_mean(x: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    n = jnp.maximum(masked_count(mask), 1)
+    return jnp.sum(jnp.where(mask, x, 0.0), axis=-1) / n
+
+
+def masked_stddev_samp(x: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Sample stddev (ddof=1) per series; NaN when fewer than 2 points,
+    mirroring SQL stddev_samp returning NULL."""
+    n = masked_count(mask)
+    mean = masked_mean(x, mask)
+    dev = jnp.where(mask, x - mean[..., None], 0.0)
+    ss = jnp.sum(dev * dev, axis=-1)
+    var = ss / jnp.maximum(n - 1, 1)
+    return jnp.where(n >= 2, jnp.sqrt(var), jnp.nan)

@@ -118,15 +118,38 @@ class ColumnarBatch:
 
     @staticmethod
     def concat(batches: Sequence["ColumnarBatch"]) -> "ColumnarBatch":
+        """Concatenate batches. String columns encoded with *different*
+        dictionaries are re-encoded against a merged dictionary (codes are
+        only comparable when the dictionary object is shared)."""
         if not batches:
             return ColumnarBatch({})
         names = list(batches[0].column_names)
         dicts: Dict[str, StringDictionary] = {}
-        for b in batches:
-            dicts.update(b.dicts)
-        return ColumnarBatch(
-            {n: np.concatenate([b[n] for b in batches]) for n in names},
-            dicts)
+        cols: Dict[str, np.ndarray] = {}
+        for n in names:
+            parts = [b[n] for b in batches]
+            col_dicts = [b.dicts.get(n) for b in batches]
+            present = [d for d in col_dicts if d is not None]
+            if present and any(d is not present[0] for d in present):
+                # Mixed dictionaries: remap every batch's codes into the
+                # first batch's dictionary (append-only, so codes already
+                # issued by it stay stable).
+                merged = present[0]
+                remapped = []
+                for part, d in zip(parts, col_dicts):
+                    if d is None or d is merged:
+                        remapped.append(part)
+                        continue
+                    mapping = np.fromiter(
+                        (merged.encode_one(s) for s in d._strings),
+                        dtype=np.int32, count=len(d))
+                    remapped.append(mapping[np.asarray(part, np.int64)])
+                parts = remapped
+                dicts[n] = merged
+            elif present:
+                dicts[n] = present[0]
+            cols[n] = np.concatenate(parts)
+        return ColumnarBatch(cols, dicts)
 
     @staticmethod
     def from_rows(rows: Sequence[Mapping[str, object]], schema,

@@ -1,0 +1,10 @@
+"""Columnar flow store: tables, materialized views, TTL, retention."""
+
+from .flow_store import FlowDatabase, RetentionMonitor, Table
+from .views import (MATERIALIZED_VIEWS, ViewSpec, ViewTable, group_reduce,
+                    group_sum)
+
+__all__ = [
+    "FlowDatabase", "RetentionMonitor", "Table",
+    "MATERIALIZED_VIEWS", "ViewSpec", "ViewTable", "group_reduce", "group_sum",
+]

@@ -1,0 +1,334 @@
+"""In-memory columnar flow database — the framework's L1 storage tier.
+
+Plays the role ClickHouse plays in the reference (tables declared in
+build/charts/theia/provisioning/datasources/create_table.sh): a `flows`
+table receiving high-rate inserts, three streaming materialized views
+(pod/node/policy — create_table.sh:92-351), result tables for the analytics
+jobs (`tadetector` create_table.sh:363-384, `recommendations` :353-360),
+TTL-based eviction (:87-88) and a retention monitor that trims the oldest
+fraction of rows when a capacity threshold is exceeded (reference:
+plugins/clickhouse-monitor/main.go:258-320).
+
+Design (TPU-first): tables are append-logs of equal-schema `ColumnarBatch`es
+sharing one dictionary set owned by the table, so any time-window selection
+is a zero-copy concat + boolean mask over fixed-width arrays, ready for
+`jax.device_put`. Materialized views are maintained *incrementally* on
+insert as integer-keyed segment sums (the SummingMergeTree equivalent),
+keeping the read path for dashboards O(view rows), not O(flow rows).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Mapping, Optional, Sequence
+
+import numpy as np
+
+from ..schema import (
+    FLOW_SCHEMA,
+    RECOMMENDATIONS_SCHEMA,
+    TADETECTOR_SCHEMA,
+    ColumnarBatch,
+    StringDictionary,
+)
+from .views import MATERIALIZED_VIEWS, ViewTable
+
+
+class Table:
+    """Append-only columnar table with store-owned dictionaries.
+
+    All inserted batches are re-encoded (if necessary) against the table's
+    dictionaries, so codes are comparable across the whole table and string
+    predicates compile to integer comparisons.
+    """
+
+    def __init__(self, name: str, schema) -> None:
+        self.name = name
+        self.schema = schema
+        self.dicts: Dict[str, StringDictionary] = {
+            c.name: StringDictionary() for c in schema if c.is_string}
+        self._batches: List[ColumnarBatch] = []
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return sum(len(b) for b in self._batches)
+
+    @property
+    def nbytes(self) -> int:
+        return sum(v.nbytes for b in self._batches
+                   for v in b.columns.values())
+
+    def _adopt(self, batch: ColumnarBatch) -> ColumnarBatch:
+        """Re-encode a batch against this table's dictionaries."""
+        cols: Dict[str, np.ndarray] = {}
+        for col in self.schema:
+            arr = batch[col.name]
+            if col.is_string:
+                src = batch.dicts.get(col.name)
+                dst = self.dicts[col.name]
+                if src is None:
+                    raise ValueError(
+                        f"string column {col.name} has no dictionary")
+                if src is not dst:
+                    mapping = np.fromiter(
+                        (dst.encode_one(s) for s in src._strings),
+                        dtype=np.int32, count=len(src))
+                    arr = mapping[np.asarray(arr, np.int64)]
+            else:
+                arr = np.asarray(arr, dtype=col.host_dtype)
+            cols[col.name] = arr
+        return ColumnarBatch(cols, self.dicts)
+
+    def insert(self, batch: ColumnarBatch) -> Optional[ColumnarBatch]:
+        """Insert a batch; returns the adopted (store-coded) batch, or
+        None when empty, so callers can fan out the exact inserted block
+        without re-reading the append log under concurrency."""
+        if len(batch) == 0:
+            return None
+        adopted = self._adopt(batch)
+        with self._lock:
+            self._batches.append(adopted)
+        return adopted
+
+    def insert_rows(self, rows: Sequence[Mapping[str, object]]) -> int:
+        if not rows:
+            return 0
+        adopted = self.insert(
+            ColumnarBatch.from_rows(rows, self.schema, self.dicts))
+        return 0 if adopted is None else len(adopted)
+
+    def scan(self) -> ColumnarBatch:
+        """Whole-table view as one batch (concat of the append log).
+
+        Compacts the log as a side effect; the swap only happens if no
+        insert raced in between (otherwise the next scan compacts)."""
+        with self._lock:
+            batches = list(self._batches)
+        if not batches:
+            return ColumnarBatch(
+                {c.name: np.zeros(0, c.host_dtype) for c in self.schema},
+                self.dicts)
+        if len(batches) == 1:
+            return batches[0]
+        merged = ColumnarBatch.concat(batches)
+        with self._lock:
+            if len(self._batches) == len(batches) and \
+                    self._batches[-1] is batches[-1]:
+                self._batches = [merged]
+        return merged
+
+    def select(self, start_time: Optional[int] = None,
+               end_time: Optional[int] = None,
+               time_column: str = "flowStartSeconds",
+               end_column: str = "flowEndSeconds") -> ColumnarBatch:
+        """Time-window select, mirroring the jobs' SQL predicates
+        (`flowStartSeconds >= start AND flowEndSeconds < end`, reference
+        policy_recommendation_job.py:796-798)."""
+        data = self.scan()
+        if start_time is None and end_time is None:
+            return data
+        mask = np.ones(len(data), dtype=bool)
+        if start_time is not None:
+            mask &= data[time_column] >= start_time
+        if end_time is not None:
+            mask &= data[end_column] < end_time
+        return data.filter(mask)
+
+    def delete_where(self, mask: np.ndarray) -> int:
+        """Delete rows matching `mask` over the current table contents.
+        Runs entirely under the table lock so a concurrent insert can
+        neither be dropped nor half-filtered."""
+        with self._lock:
+            if not self._batches:
+                return 0
+            data = (self._batches[0] if len(self._batches) == 1
+                    else ColumnarBatch.concat(self._batches))
+            if len(mask) != len(data):
+                raise ValueError(
+                    f"mask length {len(mask)} != table length {len(data)}")
+            kept = data.filter(~mask)
+            self._batches = [kept] if len(kept) else []
+        return int(mask.sum())
+
+    def delete_older_than(self, boundary: int,
+                          column: str = "timeInserted") -> int:
+        """Atomic `column < boundary` delete (mask computed under the
+        lock, so it cannot race with inserts)."""
+        with self._lock:
+            if not self._batches:
+                return 0
+            data = (self._batches[0] if len(self._batches) == 1
+                    else ColumnarBatch.concat(self._batches))
+            mask = np.asarray(data[column]) < boundary
+            if not mask.any():
+                self._batches = [data]
+                return 0
+            kept = data.filter(~mask)
+            self._batches = [kept] if len(kept) else []
+        return int(mask.sum())
+
+    def min_value(self, column: str = "timeInserted") -> Optional[int]:
+        """Min over a column without concatenating (None when empty)."""
+        with self._lock:
+            batches = list(self._batches)
+        mins = [int(b[column].min()) for b in batches if len(b)]
+        return min(mins) if mins else None
+
+    def truncate(self) -> None:
+        with self._lock:
+            self._batches = []
+
+
+class RetentionMonitor:
+    """Capacity-based retention, one round per `tick()` call.
+
+    Reference semantics (plugins/clickhouse-monitor/main.go:258-320 and
+    Helm defaults values.yaml:16-30): every interval, if used/total >
+    threshold, find the timeInserted boundary below which the oldest
+    `delete_percentage` of rows fall, delete rows older than the boundary
+    from the flows table and all materialized views, then skip
+    `skip_rounds` rounds after a successful deletion.
+    """
+
+    def __init__(self, db: "FlowDatabase", capacity_bytes: int,
+                 threshold: float = 0.5, delete_percentage: float = 0.5,
+                 skip_rounds: int = 3) -> None:
+        self.db = db
+        self.capacity_bytes = capacity_bytes
+        self.threshold = threshold
+        self.delete_percentage = delete_percentage
+        self.skip_rounds = skip_rounds
+        self._remaining_skip = 0
+
+    def usage(self) -> float:
+        return self.db.flows.nbytes / float(self.capacity_bytes)
+
+    def tick(self) -> int:
+        """Run one monitor round; returns number of flow rows deleted."""
+        if self._remaining_skip > 0:
+            self._remaining_skip -= 1
+            return 0
+        if self.usage() <= self.threshold:
+            return 0
+        flows = self.db.flows.scan()
+        n = len(flows)
+        if n == 0:
+            return 0
+        delete_n = int(n * self.delete_percentage)
+        if delete_n == 0:
+            return 0
+        t = np.sort(np.asarray(flows["timeInserted"]))
+        # timeInserted of the latest row to delete (LIMIT 1 OFFSET n-1,
+        # main.go:301-318); delete strictly-older rows like the reference's
+        # `timeInserted < boundary`.
+        boundary = t[delete_n - 1]
+        deleted = self.db.delete_flows_older_than(int(boundary))
+        if deleted:
+            self._remaining_skip = self.skip_rounds
+        return deleted
+
+
+class FlowDatabase:
+    """The full database: flows + views + result tables + retention.
+
+    `ttl_seconds` mirrors the reference's `TTL timeInserted + INTERVAL ...`
+    (default 12 HOUR, values.yaml:80); eviction runs opportunistically on
+    insert (the MergeTree merge equivalent).
+    """
+
+    def __init__(self, ttl_seconds: Optional[int] = None) -> None:
+        self.flows = Table("flows", FLOW_SCHEMA)
+        self.tadetector = Table("tadetector", TADETECTOR_SCHEMA)
+        self.recommendations = Table("recommendations",
+                                     RECOMMENDATIONS_SCHEMA)
+        self.views: Dict[str, ViewTable] = {
+            name: ViewTable(name, spec, self.flows.dicts)
+            for name, spec in MATERIALIZED_VIEWS.items()}
+        self.ttl_seconds = ttl_seconds
+
+    # -- ingest ------------------------------------------------------------
+
+    def insert_flows(self, batch: ColumnarBatch,
+                     now: Optional[int] = None) -> int:
+        """Insert a flow batch; fan out to materialized views; evict TTL."""
+        adopted = self.flows.insert(batch)
+        if adopted is None:
+            return 0
+        # Views consume the adopted (store-coded) batch so their group
+        # keys share the store dictionaries.
+        for view in self.views.values():
+            view.apply_insert_block(adopted)
+        if self.ttl_seconds is not None:
+            now = int(now if now is not None
+                      else np.max(adopted["timeInserted"]))
+            self.evict_ttl(now)
+        return len(adopted)
+
+    def insert_flow_rows(self, rows, now: Optional[int] = None) -> int:
+        return self.insert_flows(
+            ColumnarBatch.from_rows(rows, FLOW_SCHEMA, self.flows.dicts),
+            now=now)
+
+    # -- retention ---------------------------------------------------------
+
+    def evict_ttl(self, now: int) -> int:
+        if self.ttl_seconds is None:
+            return 0
+        boundary = now - self.ttl_seconds
+        # Fast path: nothing evictable — min() over parts is O(parts),
+        # not a full-table concat, so steady ingest stays O(batch).
+        oldest = self.flows.min_value("timeInserted")
+        if oldest is None or oldest >= boundary:
+            return 0
+        return self.delete_flows_older_than(boundary)
+
+    def delete_flows_older_than(self, boundary: int) -> int:
+        """timeInserted < boundary, applied to flows and every view
+        (monitor main.go:284-293 deletes from table + MVs)."""
+        deleted = self.flows.delete_older_than(boundary)
+        for view in self.views.values():
+            view.delete_older_than(boundary)
+        return deleted
+
+    def monitor(self, capacity_bytes: int, **kw) -> RetentionMonitor:
+        return RetentionMonitor(self, capacity_bytes, **kw)
+
+    # -- persistence -------------------------------------------------------
+
+    def save(self, path: str) -> None:
+        """Persist all tables to one .npz (columns + dictionary tables)."""
+        payload: Dict[str, np.ndarray] = {}
+        for table in (self.flows, self.tadetector, self.recommendations):
+            data = table.scan()
+            for col in table.schema:
+                payload[f"{table.name}/{col.name}"] = data[col.name]
+            for name, d in table.dicts.items():
+                payload[f"{table.name}/__dict__/{name}"] = np.asarray(
+                    d._strings, dtype=object)
+        np.savez_compressed(path, **{
+            k: v for k, v in payload.items()})
+
+    @classmethod
+    def load(cls, path: str,
+             ttl_seconds: Optional[int] = None) -> "FlowDatabase":
+        db = cls(ttl_seconds=None)
+        with np.load(path, allow_pickle=True) as z:
+            for table in (db.flows, db.tadetector, db.recommendations):
+                cols: Dict[str, np.ndarray] = {}
+                for name, d in table.dicts.items():
+                    key = f"{table.name}/__dict__/{name}"
+                    if key in z:
+                        for s in z[key]:
+                            d.encode_one(str(s))
+                for col in table.schema:
+                    key = f"{table.name}/{col.name}"
+                    if key in z:
+                        cols[col.name] = z[key]
+                if cols and len(next(iter(cols.values()))):
+                    batch = ColumnarBatch(cols, table.dicts)
+                    if table is db.flows:
+                        db.insert_flows(batch)
+                    else:
+                        table.insert(batch)
+        db.ttl_seconds = ttl_seconds
+        return db

@@ -1,0 +1,195 @@
+"""Streaming materialized views over the flows table.
+
+Re-provides the reference's three SummingMergeTree materialized views
+(build/charts/theia/provisioning/datasources/create_table.sh:92-351):
+
+  * flows_pod_view    — per-pod aggregation       (create_table.sh:92-175)
+  * flows_node_view   — per-node aggregation      (create_table.sh:178-241)
+  * flows_policy_view — per-NetworkPolicy totals  (create_table.sh:244-351)
+
+Semantics match ClickHouse: each *insert block* is grouped by the view's
+key columns with the metric columns summed (the MV GROUP BY runs per
+block); further collapsing of identical keys across blocks happens at
+"merge" time — here `compact()`, called automatically on read. All group
+keys are integers (dictionary codes for strings), so the per-block group-by
+is one lexsort + reduceat over fixed-width arrays — no Python-object work
+on the ingest path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..schema import ColumnarBatch, StringDictionary
+
+
+def group_reduce(keys: np.ndarray, values: np.ndarray, op: str = "sum"
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+    """Vectorized GROUP BY: `keys` [n,k] int64, `values` [n,m].
+
+    `op` is "sum" or "max". Returns (unique_keys [g,k], reduced [g,m])
+    with groups in lexicographic order. This is the host-side analogue of
+    the on-device segment reductions the analytics jobs use; lexsort +
+    reduceat keeps it allocation-lean.
+    """
+    n = keys.shape[0]
+    if n == 0:
+        return keys, values
+    order = np.lexsort(keys.T[::-1])
+    sk = keys[order]
+    sv = values[order]
+    boundary = np.empty(n, dtype=bool)
+    boundary[0] = True
+    boundary[1:] = np.any(sk[1:] != sk[:-1], axis=1)
+    starts = np.flatnonzero(boundary)
+    ufunc = np.add if op == "sum" else np.maximum
+    reduced = ufunc.reduceat(sv, starts, axis=0)
+    return sk[starts], reduced
+
+
+def group_sum(keys: np.ndarray, values: np.ndarray
+              ) -> Tuple[np.ndarray, np.ndarray]:
+    return group_reduce(keys, values, "sum")
+
+
+@dataclasses.dataclass(frozen=True)
+class ViewSpec:
+    key_columns: Tuple[str, ...]
+    sum_columns: Tuple[str, ...]
+
+
+# Column lists transcribed from the reference MV definitions (see module
+# docstring for the create_table.sh line ranges).
+MATERIALIZED_VIEWS: Dict[str, ViewSpec] = {
+    "flows_pod_view": ViewSpec(
+        key_columns=(
+            "timeInserted", "flowEndSeconds", "flowEndSecondsFromSourceNode",
+            "flowEndSecondsFromDestinationNode", "sourcePodName",
+            "destinationPodName", "destinationIP", "destinationServicePort",
+            "destinationServicePortName", "flowType", "sourcePodNamespace",
+            "destinationPodNamespace", "sourceTransportPort",
+            "destinationTransportPort", "clusterUUID"),
+        sum_columns=(
+            "octetDeltaCount", "reverseOctetDeltaCount", "throughput",
+            "reverseThroughput", "throughputFromSourceNode",
+            "throughputFromDestinationNode")),
+    "flows_node_view": ViewSpec(
+        key_columns=(
+            "timeInserted", "flowEndSeconds", "flowEndSecondsFromSourceNode",
+            "flowEndSecondsFromDestinationNode", "sourceNodeName",
+            "destinationNodeName", "sourcePodNamespace",
+            "destinationPodNamespace", "clusterUUID"),
+        sum_columns=(
+            "octetDeltaCount", "reverseOctetDeltaCount", "throughput",
+            "reverseThroughput", "throughputFromSourceNode",
+            "reverseThroughputFromSourceNode",
+            "throughputFromDestinationNode",
+            "reverseThroughputFromDestinationNode")),
+    "flows_policy_view": ViewSpec(
+        key_columns=(
+            "timeInserted", "flowEndSeconds", "flowEndSecondsFromSourceNode",
+            "flowEndSecondsFromDestinationNode", "egressNetworkPolicyName",
+            "egressNetworkPolicyNamespace", "egressNetworkPolicyRuleAction",
+            "ingressNetworkPolicyName", "ingressNetworkPolicyNamespace",
+            "ingressNetworkPolicyRuleAction", "sourcePodName",
+            "sourceTransportPort", "sourcePodNamespace",
+            "destinationPodName", "destinationTransportPort",
+            "destinationPodNamespace", "destinationServicePort",
+            "destinationServicePortName", "destinationIP", "clusterUUID"),
+        sum_columns=(
+            "octetDeltaCount", "reverseOctetDeltaCount", "throughput",
+            "reverseThroughput", "throughputFromSourceNode",
+            "reverseThroughputFromSourceNode",
+            "throughputFromDestinationNode",
+            "reverseThroughputFromDestinationNode")),
+}
+
+
+class ViewTable:
+    """One materialized view: accumulated (keys, sums) parts + compaction."""
+
+    def __init__(self, name: str, spec: ViewSpec,
+                 dicts: Dict[str, StringDictionary]) -> None:
+        self.name = name
+        self.spec = spec
+        # Shared with the flows table, so view key codes decode with the
+        # same dictionaries.
+        self.dicts = dicts
+        self._parts: List[Tuple[np.ndarray, np.ndarray]] = []
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        keys, _ = self._merged()
+        return keys.shape[0]
+
+    def apply_insert_block(self, block: ColumnarBatch) -> None:
+        """Aggregate one flows insert block into this view (the MV SELECT
+        ... GROUP BY per inserted block)."""
+        keys = np.stack([np.asarray(block[c], np.int64)
+                         for c in self.spec.key_columns], axis=1)
+        values = np.stack([np.asarray(block[c], np.int64)
+                           for c in self.spec.sum_columns], axis=1)
+        gk, gv = group_sum(keys, values)
+        with self._lock:
+            self._parts.append((gk, gv))
+
+    def _merged(self) -> Tuple[np.ndarray, np.ndarray]:
+        with self._lock:
+            parts = list(self._parts)
+        if not parts:
+            k = np.zeros((0, len(self.spec.key_columns)), np.int64)
+            v = np.zeros((0, len(self.spec.sum_columns)), np.int64)
+            return k, v
+        if len(parts) == 1:
+            return parts[0]
+        keys = np.concatenate([p[0] for p in parts], axis=0)
+        values = np.concatenate([p[1] for p in parts], axis=0)
+        gk, gv = group_sum(keys, values)
+        with self._lock:
+            # Swap in the compacted part only if no insert raced us.
+            if len(self._parts) == len(parts) and \
+                    self._parts[-1] is parts[-1]:
+                self._parts = [(gk, gv)]
+        return gk, gv
+
+    def compact(self) -> None:
+        self._merged()
+
+    def scan(self) -> ColumnarBatch:
+        """The view as a ColumnarBatch (keys + summed metrics)."""
+        keys, values = self._merged()
+        cols: Dict[str, np.ndarray] = {}
+        for i, name in enumerate(self.spec.key_columns):
+            cols[name] = keys[:, i].astype(
+                np.int32 if name in self.dicts else np.int64)
+        for i, name in enumerate(self.spec.sum_columns):
+            cols[name] = values[:, i]
+        return ColumnarBatch(
+            cols, {n: self.dicts[n] for n in self.spec.key_columns
+                   if n in self.dicts})
+
+    def delete_older_than(self, boundary: int) -> int:
+        """Drop view rows with timeInserted < boundary (retention trim
+        deletes from MVs too, clickhouse-monitor/main.go:284-293).
+        Filters part-by-part under the lock — no insert can be lost."""
+        ti = self.spec.key_columns.index("timeInserted")
+        with self._lock:
+            dropped = 0
+            new_parts = []
+            for keys, values in self._parts:
+                keep = keys[:, ti] >= boundary
+                dropped += int((~keep).sum())
+                if keep.all():
+                    new_parts.append((keys, values))
+                elif keep.any():
+                    new_parts.append((keys[keep], values[keep]))
+            self._parts = new_parts
+        return dropped
+
+    def truncate(self) -> None:
+        with self._lock:
+            self._parts = []
