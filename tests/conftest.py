@@ -20,6 +20,10 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_enable_x64", True)
+# The axon sitecustomize hook sets jax_platforms programmatically
+# ("axon,cpu"), which overrides the env var — force it back before any
+# backend initializes.
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,93 @@
+"""Runner CLI contract: tad/npr subcommands, progress file, db roundtrip."""
+
+import json
+
+import pytest
+
+from theia_tpu.data.synth import SynthConfig, generate_flows
+from theia_tpu.runner.__main__ import build_parser, main, parse_time
+from theia_tpu.store import FlowDatabase
+
+
+@pytest.fixture()
+def db_path(tmp_path):
+    db = FlowDatabase()
+    db.insert_flows(generate_flows(SynthConfig(
+        n_series=12, points_per_series=20, anomaly_fraction=0.3,
+        anomaly_magnitude=60.0, seed=4)))
+    path = str(tmp_path / "flows.npz")
+    db.save(path)
+    return path
+
+
+def test_parse_time_utc():
+    assert parse_time("2021-01-01 00:00:00") == 1609459200
+    assert parse_time("") is None
+
+
+def test_tad_job_writes_results_and_progress(db_path, tmp_path, capsys):
+    progress_path = str(tmp_path / "progress.json")
+    main(["tad", "--db", db_path, "--algo", "EWMA", "--id", "job-1",
+          "--progress-file", progress_path])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    assert json.loads(out) == {"id": "job-1", "state": "COMPLETED"}
+    progress = json.load(open(progress_path))
+    assert progress["state"] == "COMPLETED"
+    assert progress["completedStages"] == progress["totalStages"] == 4
+
+    db = FlowDatabase.load(db_path)
+    rows = db.tadetector.scan().to_rows()
+    assert any(r["id"] == "job-1" and r["anomaly"] == "true" for r in rows)
+
+
+def test_tad_agg_flow_args(db_path, capsys):
+    main(["tad", "--db", db_path, "--algo", "EWMA", "--agg-flow", "pod",
+          "--id", "job-pod",
+          "--ns-ignore-list", '["kube-system"]'])
+    db = FlowDatabase.load(db_path)
+    rows = [r for r in db.tadetector.scan().to_rows()
+            if r["id"] == "job-pod"]
+    assert rows and all(r["aggType"] == "pod" for r in rows)
+
+
+def test_tad_pod_namespace_alone_rejected(db_path):
+    with pytest.raises(SystemExit):
+        main(["tad", "--db", db_path, "--algo", "EWMA",
+              "--agg-flow", "pod", "--pod-namespace", "ns-1"])
+
+
+def test_tad_time_window_args(db_path, capsys):
+    main(["tad", "--db", db_path, "--algo", "EWMA", "--id", "job-t",
+          "--start_time", "2020-01-01 00:00:00",
+          "--end_time", "2020-01-02 00:00:00"])
+    # window before all synth data → no anomalies → filler row
+    db = FlowDatabase.load(db_path)
+    rows = [r for r in db.tadetector.scan().to_rows()
+            if r["id"] == "job-t"]
+    assert len(rows) == 1 and rows[0]["anomaly"] == "NO ANOMALY DETECTED"
+
+
+def test_npr_job(db_path, tmp_path, capsys):
+    progress_path = str(tmp_path / "p.json")
+    main(["npr", "--db", db_path, "--type", "initial", "-o", "1",
+          "--id", "rec-1", "--progress-file", progress_path])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    assert json.loads(out)["id"] == "rec-1"
+    db = FlowDatabase.load(db_path)
+    rows = db.recommendations.scan().to_rows()
+    assert any(r["id"] == "rec-1" and r["kind"] == "anp" for r in rows)
+    assert json.load(open(progress_path))["state"] == "COMPLETED"
+
+
+def test_npr_failure_marks_progress(tmp_path):
+    progress_path = str(tmp_path / "p.json")
+    with pytest.raises(BaseException):
+        main(["npr", "--db", str(tmp_path / "missing.npz"),
+              "--id", "rec-x", "--progress-file", progress_path])
+    assert json.load(open(progress_path))["state"] == "FAILED"
+
+
+def test_parser_rejects_bad_algo(db_path):
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(["tad", "--db", db_path,
+                                   "--algo", "LSTM"])

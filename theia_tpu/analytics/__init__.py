@@ -1,9 +1,11 @@
 """Analytics jobs: throughput anomaly detection + policy recommendation."""
 
+from .npr import (NAMESPACE_ALLOW_LIST, read_distinct_flows, run_npr)
 from .series import SeriesBatch, TadQuerySpec, build_series
 from .tad import ALGORITHMS, detect_anomalies, run_tad, score_series
 
 __all__ = [
     "SeriesBatch", "TadQuerySpec", "build_series",
     "ALGORITHMS", "detect_anomalies", "run_tad", "score_series",
+    "NAMESPACE_ALLOW_LIST", "read_distinct_flows", "run_npr",
 ]

@@ -26,23 +26,6 @@ from .series import SeriesBatch, TadQuerySpec, build_series
 
 ALGORITHMS = ("EWMA", "ARIMA", "DBSCAN")
 
-# tadetector columns that identify the series, per agg mode; everything
-# not listed defaults to ''/0 in the emitted rows (the reference emits a
-# mode-specific column subset, filter_df_with_true_anomalies :352-394).
-_KEY_TO_RESULT_COLUMN = {
-    "sourceIP": "sourceIP",
-    "sourceTransportPort": "sourceTransportPort",
-    "destinationIP": "destinationIP",
-    "destinationTransportPort": "destinationTransportPort",
-    "protocolIdentifier": "protocolIdentifier",
-    "flowStartSeconds": "flowStartSeconds",
-    "podNamespace": "podNamespace",
-    "podLabels": "podLabels",
-    "podName": "podName",
-    "direction": "direction",
-    "destinationServicePortName": "destinationServicePortName",
-}
-
 
 def score_series(values: np.ndarray, mask: np.ndarray, algo: str):
     """Run one algorithm over a padded [S, T] batch.
@@ -116,10 +99,13 @@ def detect_anomalies(batch: SeriesBatch, algo: str, tad_id: str,
             "anomaly": "true",
             "id": tad_id,
         }
+        # Series key names coincide with tadetector column names; keys
+        # not present for this agg mode default to ''/0 in the schema
+        # (the reference emits a mode-specific column subset,
+        # filter_df_with_true_anomalies :352-394).
         for key_name in batch.key_names:
-            col = _KEY_TO_RESULT_COLUMN[key_name]
             v = batch.keys[key_name][s]
-            row[col] = v.item() if isinstance(v, np.generic) else v
+            row[key_name] = v.item() if isinstance(v, np.generic) else v
         rows.append(row)
     return rows
 

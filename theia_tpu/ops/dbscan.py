@@ -24,6 +24,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from .masked import masked_stddev_samp
+
 DEFAULT_EPS = 2.5e8
 DEFAULT_MIN_SAMPLES = 4
 
@@ -50,7 +52,6 @@ def dbscan_scores(x: jnp.ndarray, mask: jnp.ndarray,
     stddev is still emitted to fill the tadetector row shape (the
     reference computes it in the groupby regardless of algorithm).
     """
-    from .masked import masked_stddev_samp
     anomaly = dbscan_noise(x, mask, eps=eps, min_samples=min_samples)
     calc = jnp.zeros_like(x)
     std = masked_stddev_samp(x, mask)

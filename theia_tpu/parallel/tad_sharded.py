@@ -1,0 +1,110 @@
+"""Sharded TAD scoring: shard_map over the (series × time) mesh.
+
+This is the multi-chip version of theia_tpu.ops scoring (SURVEY §2.7:
+Spark's executor data-parallelism → shard_map over the series axis; the
+per-task whole-series processing → a sequence-parallel associative scan
+over the time axis). One jitted step computes, fully sharded:
+
+  * EWMA via local `associative_scan` + cross-shard composition of the
+    per-shard affine summaries (all_gather over the "time" axis — the
+    classic parallel-scan block decomposition),
+  * masked sample stddev via psum over the "time" axis,
+  * the anomaly mask, and a global anomaly count via psum over both axes
+    (the collective the reference's driver-side `count()` implies).
+
+The outputs come back with the same [S, T] sharding as the inputs, so a
+caller can keep them device-resident for the result-row gather.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..ops.ewma import DEFAULT_ALPHA
+from .mesh import SERIES_AXIS, TIME_AXIS, Mesh
+
+
+def _local_scan(a, b):
+    def combine(lhs, rhs):
+        a1, b1 = lhs
+        a2, b2 = rhs
+        return a1 * a2, a2 * b1 + b2
+    return jax.lax.associative_scan(combine, (a, b), axis=-1)
+
+
+def _ewma_timeshard(x: jnp.ndarray, alpha: float,
+                    n_time_shards: int) -> jnp.ndarray:
+    """EWMA along a time-sharded axis: local scan + shard composition.
+
+    Each time shard holds a contiguous [S_loc, T_loc] block. The affine
+    summary (A_tot, B_tot) of every earlier shard is composed (in shard
+    order) into an incoming state, then applied to the local cumulative
+    scan: e = A_cum · e_in + B_cum.
+    """
+    a = jnp.full_like(x, 1.0 - alpha)
+    b = alpha * x
+    a_cum, b_cum = _local_scan(a, b)
+
+    if n_time_shards == 1:
+        return b_cum  # e_in = 0
+
+    a_tot = a_cum[:, -1]
+    b_tot = b_cum[:, -1]
+    a_all = jax.lax.all_gather(a_tot, TIME_AXIS)  # [n_shards, S_loc]
+    b_all = jax.lax.all_gather(b_tot, TIME_AXIS)
+    my = jax.lax.axis_index(TIME_AXIS)
+
+    e_in = jnp.zeros_like(a_tot)
+    for j in range(n_time_shards):  # static, tiny (mesh axis size)
+        take = j < my
+        e_in = jnp.where(take, a_all[j] * e_in + b_all[j], e_in)
+    return a_cum * e_in[:, None] + b_cum
+
+
+def _sharded_step(x, mask, alpha: float, n_time_shards: int):
+    xz = jnp.where(mask, x, 0.0)
+    e = _ewma_timeshard(xz, alpha, n_time_shards)
+
+    # Masked stddev_samp with cross-time-shard reductions.
+    cnt = jax.lax.psum(jnp.sum(mask.astype(x.dtype), axis=-1), TIME_AXIS)
+    total = jax.lax.psum(jnp.sum(xz, axis=-1), TIME_AXIS)
+    mean = total / jnp.maximum(cnt, 1.0)
+    ss = jax.lax.psum(
+        jnp.sum(jnp.where(mask, (x - mean[:, None]) ** 2, 0.0), axis=-1),
+        TIME_AXIS)
+    var = ss / jnp.maximum(cnt - 1.0, 1.0)
+    std = jnp.where(cnt >= 2, jnp.sqrt(var), jnp.nan)
+
+    anomaly = (jnp.abs(xz - e) > std[:, None]) & mask
+    count = jax.lax.psum(jnp.sum(anomaly.astype(jnp.int32)),
+                         (SERIES_AXIS, TIME_AXIS))
+    return e, std, anomaly, count
+
+
+def make_sharded_ewma(mesh: Mesh, alpha: float = DEFAULT_ALPHA):
+    """Build the jitted sharded scoring step for a mesh.
+
+    Returns fn(x [S,T], mask [S,T]) → (ewma, stddev [S], anomaly, count)
+    with S divisible by the series-axis size and T by the time-axis size.
+    """
+    n_time = mesh.shape[TIME_AXIS]
+    step = functools.partial(_sharded_step, alpha=alpha,
+                             n_time_shards=n_time)
+    mapped = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(SERIES_AXIS, TIME_AXIS), P(SERIES_AXIS, TIME_AXIS)),
+        out_specs=(P(SERIES_AXIS, TIME_AXIS), P(SERIES_AXIS),
+                   P(SERIES_AXIS, TIME_AXIS), P()),
+        check_vma=False)
+    return jax.jit(mapped)
+
+
+def shard_arrays(mesh: Mesh, x, mask) -> Tuple[jax.Array, jax.Array]:
+    """device_put host arrays with the step's input sharding."""
+    spec = NamedSharding(mesh, P(SERIES_AXIS, TIME_AXIS))
+    return jax.device_put(x, spec), jax.device_put(mask, spec)

@@ -1,0 +1,5 @@
+"""tpu-job-runner: Spark-job CLI contract + progress reporting."""
+
+from .progress import NPR_STAGES, TAD_STAGES, JobProgress
+
+__all__ = ["JobProgress", "TAD_STAGES", "NPR_STAGES"]

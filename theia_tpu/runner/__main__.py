@@ -1,0 +1,171 @@
+"""tpu-job-runner: the analytics jobs behind the Spark-job CLI contract.
+
+Replaces the reference's SparkApplication payloads with a standalone
+process the controllers can spawn. Option names/forms mirror the
+reference scripts so the control plane stays drop-in compatible:
+
+  tad — plugins/anomaly-detection/anomaly_detection.py:744-778 and the
+        controller arg-build pkg/controller/anomalydetector/
+        controller.go:525-620 (--algo, --start_time, --end_time, --id,
+        --ns-ignore-list, --agg-flow, --pod-label, --pod-name,
+        --pod-namespace, --external-ip, --svc-port-name)
+  npr — plugins/policy-recommendation/policy_recommendation_job.py:
+        1034-1084 (--type, --limit, --option, --start_time, --end_time,
+        --ns_allow_list, --id, --rm_labels, --to_services)
+
+Instead of a JDBC URL the runner takes --db (FlowDatabase .npz path);
+results are written back into the same database file. --progress-file
+emits Spark-UI-shaped progress (see progress.py).
+
+Usage:
+  python -m theia_tpu.runner tad --db flows.npz --algo EWMA
+  python -m theia_tpu.runner npr --db flows.npz --type initial -o 1
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import sys
+from typing import Optional
+
+TIME_FORMAT = "%Y-%m-%d %H:%M:%S"
+
+
+def parse_time(value: Optional[str]) -> Optional[int]:
+    if not value:
+        return None
+    dt = datetime.datetime.strptime(value, TIME_FORMAT)
+    return int(dt.replace(tzinfo=datetime.timezone.utc).timestamp())
+
+
+def parse_json_list(value: Optional[str]) -> list:
+    if not value:
+        return []
+    parsed = json.loads(value)
+    if not isinstance(parsed, list):
+        raise argparse.ArgumentTypeError(
+            f"expected a JSON list, got {value!r}")
+    return parsed
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="theia_tpu.runner",
+        description="TPU-native analytics job runner")
+    sub = p.add_subparsers(dest="job", required=True)
+
+    tad = sub.add_parser("tad", help="throughput anomaly detection")
+    tad.add_argument("--db", required=True,
+                     help="FlowDatabase .npz path")
+    tad.add_argument("-a", "--algo", required=True,
+                     choices=["EWMA", "ARIMA", "DBSCAN"])
+    tad.add_argument("-s", "--start_time", default="",
+                     help=f"'{TIME_FORMAT}' UTC")
+    tad.add_argument("-e", "--end_time", default="")
+    tad.add_argument("-i", "--id", default=None)
+    tad.add_argument("-n", "--ns-ignore-list", "--ns_ignore_list",
+                     dest="ns_ignore_list", default="")
+    tad.add_argument("-f", "--agg-flow", dest="agg_flow", default="",
+                     choices=["", "pod", "external", "svc"])
+    tad.add_argument("-l", "--pod-label", dest="pod_label", default="")
+    tad.add_argument("-N", "--pod-name", dest="pod_name", default="")
+    tad.add_argument("-P", "--pod-namespace", dest="pod_namespace",
+                     default="")
+    tad.add_argument("-x", "--external-ip", dest="external_ip",
+                     default="")
+    tad.add_argument("-p", "--svc-port-name", dest="svc_port_name",
+                     default="")
+    tad.add_argument("--progress-file", default=None)
+
+    npr = sub.add_parser("npr", help="network policy recommendation")
+    npr.add_argument("--db", required=True)
+    npr.add_argument("-t", "--type", dest="rec_type", default="initial",
+                     choices=["initial", "subsequent"])
+    npr.add_argument("-l", "--limit", type=int, default=0)
+    npr.add_argument("-o", "--option", type=int, default=1,
+                     choices=[1, 2, 3])
+    npr.add_argument("-s", "--start_time", default="")
+    npr.add_argument("-e", "--end_time", default="")
+    npr.add_argument("-n", "--ns_allow_list", default="")
+    npr.add_argument("-i", "--id", default=None)
+    npr.add_argument("--rm_labels", default="true")
+    npr.add_argument("--to_services", default="true")
+    npr.add_argument("--progress-file", default=None)
+    return p
+
+
+def run_tad_job(args) -> str:
+    from ..analytics import TadQuerySpec, run_tad
+    from ..store import FlowDatabase
+    from .progress import TAD_STAGES, JobProgress
+
+    spec = TadQuerySpec(
+        start_time=parse_time(args.start_time),
+        end_time=parse_time(args.end_time),
+        ns_ignore_list=parse_json_list(args.ns_ignore_list),
+        agg_flow=args.agg_flow,
+        pod_label=args.pod_label,
+        pod_name=args.pod_name,
+        pod_namespace=args.pod_namespace,
+        external_ip=args.external_ip,
+        svc_port_name=args.svc_port_name,
+    )
+    if args.pod_namespace and not (args.pod_label or args.pod_name):
+        raise SystemExit(
+            "invalid request: 'pod-namespace' argument can not be used "
+            "alone, should be specified along pod-label or pod-name")
+    progress = JobProgress(args.id or "tad", TAD_STAGES,
+                           path=args.progress_file)
+    try:
+        db = FlowDatabase.load(args.db)
+        job_id = run_tad(db, args.algo, spec, tad_id=args.id,
+                         progress=progress)
+        db.save(args.db)
+    except BaseException as e:
+        progress.fail(str(e))
+        raise
+    return job_id
+
+
+def run_npr_job(args) -> str:
+    from ..analytics import run_npr
+    from ..store import FlowDatabase
+    from .progress import NPR_STAGES, JobProgress
+
+    progress = JobProgress(args.id or "npr", NPR_STAGES,
+                           path=args.progress_file)
+    try:
+        db = FlowDatabase.load(args.db)
+        job_id = run_npr(
+            db,
+            recommendation_type=args.rec_type,
+            limit=args.limit,
+            option=args.option,
+            start_time=parse_time(args.start_time),
+            end_time=parse_time(args.end_time),
+            ns_allow_list=(parse_json_list(args.ns_allow_list) or None),
+            rm_labels=args.rm_labels != "false",
+            to_services=args.to_services != "false",
+            recommendation_id=args.id,
+            progress=progress,
+        )
+        db.save(args.db)
+    except BaseException as e:
+        progress.fail(str(e))
+        raise
+    return job_id
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    if args.job == "tad":
+        job_id = run_tad_job(args)
+    else:
+        job_id = run_npr_job(args)
+    print(json.dumps({"id": job_id, "state": "COMPLETED"}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,92 @@
+"""Job progress reporting, shaped like the Spark UI REST the reference
+controllers scrape (pkg/controller/util.go:129-159 reads
+/api/v1/applications/<id>/stages and surfaces completedStages/
+totalStages into CRD status).
+
+The runner updates a JSON document after every stage; it is written
+atomically to a file (for the file-based manager/controller seam) and
+kept in memory for in-process callers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+from typing import List, Optional
+
+
+class JobProgress:
+    """Tracks named stages of one job run.
+
+    States mirror the Spark application lifecycle the controllers map
+    into CRD status (controller.go:458-500): RUNNING → COMPLETED/FAILED.
+    """
+
+    def __init__(self, job_id: str, stages: List[str],
+                 path: Optional[str] = None) -> None:
+        self.job_id = job_id
+        self.stages = list(stages)
+        self.path = path
+        self._completed = 0
+        self._state = "RUNNING"
+        self._error = ""
+        self._current = ""
+        self._started = time.time()
+        self._lock = threading.Lock()
+        self._flush()
+
+    def stage(self, name: str) -> None:
+        with self._lock:
+            if self._current:
+                self._completed += 1
+            self._current = name
+        self._flush()
+
+    def done(self) -> None:
+        with self._lock:
+            self._completed = len(self.stages)
+            self._current = ""
+            self._state = "COMPLETED"
+        self._flush()
+
+    def fail(self, error: str) -> None:
+        with self._lock:
+            self._state = "FAILED"
+            self._error = error
+        self._flush()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "id": self.job_id,
+                "state": self._state,
+                "currentStage": self._current,
+                "completedStages": self._completed,
+                "totalStages": len(self.stages),
+                "errorMsg": self._error,
+                "startedAt": self._started,
+            }
+
+    def _flush(self) -> None:
+        if not self.path:
+            return
+        snap = self.snapshot()
+        d = os.path.dirname(self.path) or "."
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=".progress-")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(snap, f)
+            os.replace(tmp, self.path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+
+TAD_STAGES = ["read", "tensorize", "score", "write"]
+NPR_STAGES = ["read", "recommend", "write"]

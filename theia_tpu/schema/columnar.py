@@ -16,6 +16,7 @@ plugins/policy-recommendation/policy_recommendation_job.py map steps).
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, Iterable, List, Mapping, Optional, Sequence
 
 import numpy as np
@@ -29,21 +30,29 @@ class StringDictionary:
     flow filter in policy_recommendation_job.py:785-802).
     """
 
-    __slots__ = ("_to_code", "_strings")
+    __slots__ = ("_to_code", "_strings", "_lock")
 
     def __init__(self) -> None:
         self._to_code: Dict[str, int] = {"": 0}
         self._strings: List[str] = [""]
+        self._lock = threading.Lock()
 
     def __len__(self) -> int:
         return len(self._strings)
 
     def encode_one(self, s: str) -> int:
+        # Reads are lock-free (append-only tables); allocation of a new
+        # code is locked so concurrent encoders can't mint two codes for
+        # the same string (the tables share these dictionaries across
+        # insert threads).
         code = self._to_code.get(s)
         if code is None:
-            code = len(self._strings)
-            self._to_code[s] = code
-            self._strings.append(s)
+            with self._lock:
+                code = self._to_code.get(s)
+                if code is None:
+                    code = len(self._strings)
+                    self._strings.append(s)
+                    self._to_code[s] = code
         return code
 
     def encode(self, values: Sequence[str]) -> np.ndarray:
@@ -66,6 +75,14 @@ class StringDictionary:
     def lookup(self, s: str) -> Optional[int]:
         """Code for `s` if present, else None (never allocates)."""
         return self._to_code.get(s)
+
+    def copy(self) -> "StringDictionary":
+        """Independent copy (same codes for existing strings)."""
+        out = StringDictionary()
+        with self._lock:
+            out._strings = list(self._strings)
+            out._to_code = dict(self._to_code)
+        return out
 
 
 class ColumnarBatch:
@@ -131,10 +148,11 @@ class ColumnarBatch:
             col_dicts = [b.dicts.get(n) for b in batches]
             present = [d for d in col_dicts if d is not None]
             if present and any(d is not present[0] for d in present):
-                # Mixed dictionaries: remap every batch's codes into the
-                # first batch's dictionary (append-only, so codes already
-                # issued by it stay stable).
-                merged = present[0]
+                # Mixed dictionaries: remap every batch's codes into a
+                # fresh copy of the first batch's dictionary (codes it
+                # already issued stay stable; the originals — possibly
+                # store-owned — are left unmutated).
+                merged = present[0].copy()
                 remapped = []
                 for part, d in zip(parts, col_dicts):
                     if d is None or d is merged:

@@ -305,8 +305,7 @@ class FlowDatabase:
             for name, d in table.dicts.items():
                 payload[f"{table.name}/__dict__/{name}"] = np.asarray(
                     d._strings, dtype=object)
-        np.savez_compressed(path, **{
-            k: v for k, v in payload.items()})
+        np.savez_compressed(path, **payload)
 
     @classmethod
     def load(cls, path: str,
