@@ -1,0 +1,178 @@
+"""Manager REST API + controller state machine + CLI, end to end."""
+
+import io
+import json
+import tarfile
+import urllib.request
+
+import pytest
+
+from theia_tpu.cli.__main__ import main as cli_main
+from theia_tpu.data.synth import SynthConfig, generate_flows
+from theia_tpu.manager import (
+    KIND_NPR,
+    KIND_TAD,
+    STATE_COMPLETED,
+    TheiaManagerServer,
+    job_id_from_name,
+)
+from theia_tpu.store import FlowDatabase
+
+GROUP = "/apis/intelligence.theia.antrea.io/v1alpha1"
+
+
+@pytest.fixture()
+def server():
+    db = FlowDatabase()
+    db.insert_flows(generate_flows(SynthConfig(
+        n_series=12, points_per_series=20, anomaly_fraction=0.3,
+        anomaly_magnitude=60.0, seed=6)))
+    srv = TheiaManagerServer(db, port=0)  # ephemeral port
+    srv.start_background()
+    yield srv
+    srv.shutdown()
+
+
+def _get(srv, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}{path}", timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _post(srv, path, body=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}{path}", method="POST",
+        data=json.dumps(body or {}).encode())
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def test_job_name_parsing():
+    assert job_id_from_name(
+        KIND_NPR, "pr-0E9B29D3-6617-4D75-9744-03FBEF542321".lower()
+    ) == "0e9b29d3-6617-4d75-9744-03fbef542321"
+    with pytest.raises(ValueError):
+        job_id_from_name(KIND_TAD, "pr-x")
+
+
+def test_tad_lifecycle_over_rest(server):
+    doc = _post(server, f"{GROUP}/throughputanomalydetectors",
+                {"jobType": "EWMA"})
+    name = doc["metadata"]["name"]
+    assert name.startswith("tad-")
+    assert server.controller.wait_all()
+    got = _get(server, f"{GROUP}/throughputanomalydetectors/{name}")
+    assert got["status"]["state"] == STATE_COMPLETED
+    assert got["status"]["completedStages"] == 4
+    assert got["stats"], "expected anomaly stats on COMPLETED job"
+    assert all(s["algoType"] == "EWMA" for s in got["stats"])
+
+    listing = _get(server, f"{GROUP}/throughputanomalydetectors")
+    assert any(i["metadata"]["name"] == name for i in listing["items"])
+
+    # delete GCs the result rows
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}{GROUP}/"
+        f"throughputanomalydetectors/{name}", method="DELETE")
+    urllib.request.urlopen(req, timeout=10)
+    data = server.controller.db.tadetector.scan()
+    assert len(data) == 0
+
+
+def test_npr_lifecycle_and_outcome(server):
+    doc = _post(server, f"{GROUP}/networkpolicyrecommendations",
+                {"jobType": "initial", "policyType": "anp-deny-applied"})
+    name = doc["metadata"]["name"]
+    assert server.controller.wait_all()
+    got = _get(server, f"{GROUP}/networkpolicyrecommendations/{name}")
+    assert got["status"]["state"] == STATE_COMPLETED
+    outcome = got["status"]["recommendationOutcome"]
+    assert "kind: NetworkPolicy" in outcome and "---" in outcome
+
+
+def test_invalid_job_spec_fails_cleanly(server):
+    doc = _post(server, f"{GROUP}/networkpolicyrecommendations",
+                {"jobType": "initial", "policyType": "bogus"})
+    name = doc["metadata"]["name"]
+    assert server.controller.wait_all()
+    got = _get(server, f"{GROUP}/networkpolicyrecommendations/{name}")
+    assert got["status"]["state"] == "FAILED"
+    assert "policyType" in got["status"]["errorMsg"]
+
+
+def test_stats_api(server):
+    doc = _get(server, "/apis/stats.theia.antrea.io/v1alpha1/clickhouse")
+    assert doc["diskInfos"][0]["totalSpace"]
+    tables = {t["tableName"] for t in doc["tableInfos"]}
+    assert {"flows", "tadetector", "recommendations",
+            "flows_pod_view"} <= tables
+    disk = _get(server, "/apis/stats.theia.antrea.io/v1alpha1/"
+                        "clickhouse/diskInfo")
+    assert "tableInfos" not in disk
+
+
+def test_support_bundle(server):
+    _post(server, "/apis/system.theia.antrea.io/v1alpha1/supportbundles")
+    import time
+    for _ in range(100):
+        doc = _get(server,
+                   "/apis/system.theia.antrea.io/v1alpha1/supportbundles")
+        if doc["status"] == "collected":
+            break
+        time.sleep(0.05)
+    assert doc["status"] == "collected" and doc["size"] > 0
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/apis/system.theia.antrea.io"
+            "/v1alpha1/supportbundles/theia-manager/download",
+            timeout=10) as r:
+        data = r.read()
+    names = tarfile.open(fileobj=io.BytesIO(data), mode="r:gz").getnames()
+    assert "stats/diskInfo.json" in names and "jobs.json" in names
+
+
+def test_gc_stale_results():
+    db = FlowDatabase()
+    db.tadetector.insert_rows([{"id": "dead-beef", "anomaly": "true"}])
+    srv = TheiaManagerServer(db, port=0)  # controller GCs at startup
+    try:
+        assert len(db.tadetector) == 0
+    finally:
+        srv.shutdown()
+
+
+def test_cli_end_to_end(server, capsys):
+    addr = ["--manager-addr", f"http://127.0.0.1:{server.port}"]
+    cli_main(addr + ["tad", "run", "--algo", "EWMA", "--wait"])
+    out = capsys.readouterr().out
+    assert "Successfully started" in out
+    assert "EWMA" in out  # stats table printed
+
+    cli_main(addr + ["tad", "list"])
+    out = capsys.readouterr().out
+    assert "COMPLETED" in out
+
+    cli_main(addr + ["policy-recommendation", "run", "--wait"])
+    out = capsys.readouterr().out
+    assert "kind: NetworkPolicy" in out
+
+    cli_main(addr + ["clickhouse", "status", "--tableInfo"])
+    out = capsys.readouterr().out
+    assert "flows" in out
+
+    cli_main(addr + ["version"])
+    out = capsys.readouterr().out
+    assert "theia version" in out
+
+
+def test_cli_retrieve_and_delete(server, capsys):
+    addr = ["--manager-addr", f"http://127.0.0.1:{server.port}"]
+    cli_main(addr + ["tad", "run", "--algo", "DBSCAN"])
+    name = capsys.readouterr().out.strip().split()[-1]
+    assert server.controller.wait_all()
+    cli_main(addr + ["tad", "retrieve", name])
+    out = capsys.readouterr().out
+    assert "DBSCAN" in out or "No anomalies found" in out
+    cli_main(addr + ["tad", "delete", name])
+    assert "deleted" in capsys.readouterr().out
+    with pytest.raises(SystemExit):
+        cli_main(addr + ["tad", "status", name])

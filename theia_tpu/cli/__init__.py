@@ -1,0 +1,1 @@
+"""The `theia` command line interface (python -m theia_tpu.cli)."""

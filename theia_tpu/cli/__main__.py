@@ -1,0 +1,426 @@
+"""`theia` — the command line interface.
+
+Re-provides the reference's cobra CLI (pkg/theia/commands/): the same
+command tree, flag names and output shapes, talking to the manager REST
+API. Where the reference port-forwards into the cluster
+(pkg/theia/portforwarder), this CLI takes --manager-addr (default
+http://127.0.0.1:11347).
+
+  theia policy-recommendation  run|status|retrieve|list|delete   (alias pr)
+  theia throughput-anomaly-detection ...                        (alias tad)
+  theia clickhouse status [--diskInfo --tableInfo --insertRate
+                           --stackTraces]
+  theia supportbundle
+  theia version
+
+`run --wait` polls job status every 5 s like the reference
+(pkg/theia/commands/config/config.go StatusCheckPollInterval; loop at
+policy_recommendation_run.go:223-259).
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+import uuid
+from typing import Dict, Optional
+
+DEFAULT_ADDR = "http://127.0.0.1:11347"
+GROUP = "/apis/intelligence.theia.antrea.io/v1alpha1"
+POLL_INTERVAL = 5.0
+POLL_TIMEOUT = 3600.0
+
+NPR_RESOURCE = "networkpolicyrecommendations"
+TAD_RESOURCE = "throughputanomalydetectors"
+
+TIME_FORMAT = "%Y-%m-%d %H:%M:%S"
+
+
+class APIError(SystemExit):
+    pass
+
+
+def _request(addr: str, method: str, path: str,
+             body: Optional[Dict] = None) -> Dict:
+    req = urllib.request.Request(
+        addr + path, method=method,
+        data=json.dumps(body).encode() if body is not None else None,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            raw = resp.read()
+    except urllib.error.HTTPError as e:
+        detail = e.read().decode(errors="replace")
+        try:
+            detail = json.loads(detail).get("message", detail)
+        except Exception:
+            pass
+        raise APIError(f"error: {e.code} from manager: {detail}")
+    except urllib.error.URLError as e:
+        raise APIError(
+            f"error: cannot reach theia-manager at {addr}: {e.reason}")
+    return json.loads(raw) if raw else {}
+
+
+def _parse_time_arg(value: str, flag: str) -> Optional[int]:
+    if not value:
+        return None
+    try:
+        dt = datetime.datetime.strptime(value, TIME_FORMAT)
+    except ValueError:
+        raise SystemExit(
+            f"error: {flag} should be in '{TIME_FORMAT}' format")
+    return int(dt.replace(tzinfo=datetime.timezone.utc).timestamp())
+
+
+def _wait_for_job(addr: str, resource: str, name: str) -> Dict:
+    deadline = time.time() + POLL_TIMEOUT
+    while time.time() < deadline:
+        doc = _request(addr, "GET", f"{GROUP}/{resource}/{name}")
+        state = (doc.get("status") or {}).get("state", "")
+        if state in ("COMPLETED", "FAILED"):
+            return doc
+        time.sleep(POLL_INTERVAL)
+    raise APIError(f"error: timed out waiting for job {name}")
+
+
+def _print_job_table(items) -> None:
+    fmt = "{:<44} {:<10} {:<10} {}"
+    print(fmt.format("NAME", "STATE", "PROGRESS", "ERROR"))
+    for doc in items:
+        st = doc.get("status") or {}
+        progress = f"{st.get('completedStages', 0)}/" \
+                   f"{st.get('totalStages', 0)}"
+        print(fmt.format(doc["metadata"]["name"], st.get("state", ""),
+                         progress, st.get("errorMsg", "")))
+
+
+# -- policy-recommendation ----------------------------------------------
+
+def npr_run(args) -> None:
+    name = "pr-" + str(uuid.uuid4())
+    body = {
+        "metadata": {"name": name},
+        "jobType": args.type,
+        "limit": args.limit,
+        "policyType": args.policy_type,
+        "startInterval": _parse_time_arg(args.start_time, "start-time"),
+        "endInterval": _parse_time_arg(args.end_time, "end-time"),
+        "nsAllowList": json.loads(args.ns_allow_list)
+        if args.ns_allow_list else None,
+        "excludeLabels": args.exclude_labels,
+        "toServices": args.to_services,
+        "executorInstances": args.executor_instances,
+    }
+    body = {k: v for k, v in body.items() if v is not None}
+    _request(args.manager_addr, "POST", f"{GROUP}/{NPR_RESOURCE}", body)
+    print(f"Successfully created policy recommendation job with name "
+          f"{name}")
+    if args.wait:
+        doc = _wait_for_job(args.manager_addr, NPR_RESOURCE, name)
+        st = doc.get("status") or {}
+        if st.get("state") == "FAILED":
+            raise APIError(
+                f"error: job failed: {st.get('errorMsg', '')}")
+        outcome = st.get("recommendationOutcome", "")
+        if args.file:
+            with open(args.file, "w") as f:
+                f.write(outcome)
+            print(f"Recommendation written to {args.file}")
+        else:
+            print(outcome)
+
+
+def npr_status(args) -> None:
+    doc = _request(args.manager_addr, "GET",
+                   f"{GROUP}/{NPR_RESOURCE}/{args.name}")
+    st = doc.get("status") or {}
+    print(f"Status of this policy recommendation job is "
+          f"{st.get('state', '')}")
+    if st.get("state") == "RUNNING":
+        print(f"Completed stages: {st.get('completedStages', 0)}/"
+              f"{st.get('totalStages', 0)}")
+
+
+def npr_retrieve(args) -> None:
+    doc = _request(args.manager_addr, "GET",
+                   f"{GROUP}/{NPR_RESOURCE}/{args.name}")
+    outcome = (doc.get("status") or {}).get("recommendationOutcome", "")
+    if args.file:
+        with open(args.file, "w") as f:
+            f.write(outcome)
+        print(f"Recommendation written to {args.file}")
+    else:
+        print(outcome)
+
+
+def npr_list(args) -> None:
+    doc = _request(args.manager_addr, "GET", f"{GROUP}/{NPR_RESOURCE}")
+    _print_job_table(doc.get("items", []))
+
+
+def npr_delete(args) -> None:
+    _request(args.manager_addr, "DELETE",
+             f"{GROUP}/{NPR_RESOURCE}/{args.name}")
+    print(f"Successfully deleted policy recommendation job with name "
+          f"{args.name}")
+
+
+# -- throughput-anomaly-detection ---------------------------------------
+
+def tad_run(args) -> None:
+    name = "tad-" + str(uuid.uuid4())
+    body = {
+        "metadata": {"name": name},
+        "jobType": args.algo,
+        "startInterval": _parse_time_arg(args.start_time, "start-time"),
+        "endInterval": _parse_time_arg(args.end_time, "end-time"),
+        "nsIgnoreList": json.loads(args.ns_ignore_list)
+        if args.ns_ignore_list else None,
+        "aggFlow": args.agg_flow or None,
+        "podLabel": args.pod_label or None,
+        "podName": args.pod_name or None,
+        "podNameSpace": args.pod_namespace or None,
+        "externalIp": args.external_ip or None,
+        "servicePortName": args.svc_port_name or None,
+        "executorInstances": args.executor_instances,
+    }
+    body = {k: v for k, v in body.items() if v is not None}
+    _request(args.manager_addr, "POST", f"{GROUP}/{TAD_RESOURCE}", body)
+    print(f"Successfully started Throughput Anomaly Detection job with "
+          f"name: {name}")
+    if args.wait:
+        doc = _wait_for_job(args.manager_addr, TAD_RESOURCE, name)
+        st = doc.get("status") or {}
+        if st.get("state") == "FAILED":
+            raise APIError(
+                f"error: job failed: {st.get('errorMsg', '')}")
+        _print_tad_stats(doc.get("stats", []))
+
+
+def _print_table(rows, cols) -> None:
+    """Column-aligned table; cells are newline-stripped and truncated."""
+    def cell(r, c):
+        return str(r.get(c, "")).replace("\n", " ")[:80]
+
+    widths = {c: max(len(c), *(len(cell(r, c)) for r in rows))
+              for c in cols}
+    print("  ".join(c.ljust(widths[c]) for c in cols))
+    for r in rows:
+        print("  ".join(cell(r, c).ljust(widths[c]) for c in cols))
+
+
+def _print_tad_stats(stats) -> None:
+    if not stats:
+        print("No anomalies found")
+        return
+    _print_table(stats, [
+        "id", "sourceIP", "sourceTransportPort", "destinationIP",
+        "destinationTransportPort", "flowEndSeconds", "throughput",
+        "aggType", "algoType", "anomaly"])
+
+
+def tad_status(args) -> None:
+    doc = _request(args.manager_addr, "GET",
+                   f"{GROUP}/{TAD_RESOURCE}/{args.name}")
+    st = doc.get("status") or {}
+    print(f"Status of this anomaly detection job is "
+          f"{st.get('state', '')}")
+    if st.get("state") == "RUNNING":
+        print(f"Completed stages: {st.get('completedStages', 0)}/"
+              f"{st.get('totalStages', 0)}")
+
+
+def tad_retrieve(args) -> None:
+    doc = _request(args.manager_addr, "GET",
+                   f"{GROUP}/{TAD_RESOURCE}/{args.name}")
+    stats = doc.get("stats", [])
+    if args.file:
+        with open(args.file, "w") as f:
+            json.dump(stats, f, indent=2)
+        print(f"Anomalies written to {args.file}")
+    else:
+        _print_tad_stats(stats)
+
+
+def tad_list(args) -> None:
+    doc = _request(args.manager_addr, "GET", f"{GROUP}/{TAD_RESOURCE}")
+    _print_job_table(doc.get("items", []))
+
+
+def tad_delete(args) -> None:
+    _request(args.manager_addr, "DELETE",
+             f"{GROUP}/{TAD_RESOURCE}/{args.name}")
+    print(f"Successfully deleted Throughput Anomaly Detection job with "
+          f"name: {args.name}")
+
+
+# -- clickhouse / supportbundle / version -------------------------------
+
+def clickhouse_status(args) -> None:
+    components = [c for c, on in (
+        ("diskInfo", args.diskInfo), ("tableInfo", args.tableInfo),
+        ("insertRate", args.insertRate),
+        ("stackTraces", args.stackTraces)) if on]
+    if not components:
+        components = ["diskInfo", "tableInfo", "insertRate"]
+    for comp in components:
+        doc = _request(args.manager_addr, "GET",
+                       "/apis/stats.theia.antrea.io/v1alpha1/"
+                       f"clickhouse/{comp}")
+        key = {"diskInfo": "diskInfos", "tableInfo": "tableInfos",
+               "insertRate": "insertRates",
+               "stackTraces": "stackTraces"}[comp]
+        rows = doc.get(key, [])
+        print(f"== {comp} ==")
+        if rows:
+            _print_table(rows, list(rows[0].keys()))
+
+
+def supportbundle(args) -> None:
+    path = "/apis/system.theia.antrea.io/v1alpha1/supportbundles"
+    _request(args.manager_addr, "POST", path)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        doc = _request(args.manager_addr, "GET", path)
+        if doc.get("status") == "collected":
+            break
+        time.sleep(0.5)
+    else:
+        raise APIError("error: support bundle collection timed out")
+    req = urllib.request.Request(
+        args.manager_addr + path + "/theia-manager/download")
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        data = resp.read()
+    out = args.file or "theia-supportbundle.tar.gz"
+    with open(out, "wb") as f:
+        f.write(data)
+    print(f"Support bundle written to {out} ({len(data)} bytes)")
+
+
+def version(args) -> None:
+    from .. import __version__
+    print(f"theia version: {__version__}")
+    try:
+        doc = _request(args.manager_addr, "GET", "/version")
+        print(f"theia-manager version: {doc.get('version', 'unknown')}")
+    except SystemExit:
+        print("theia-manager version: unavailable")
+
+
+# -- parser --------------------------------------------------------------
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="theia", description="theia-tpu command line tool")
+    p.add_argument("--manager-addr", default=DEFAULT_ADDR,
+                   help="theia-manager API address")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    def add_job_commands(group, run_fn, status_fn, retrieve_fn, list_fn,
+                         delete_fn, run_flags):
+        gsub = group.add_subparsers(dest="action", required=True)
+        run = gsub.add_parser("run")
+        run_flags(run)
+        run.add_argument("--wait", action="store_true")
+        run.add_argument("-f", "--file", default="")
+        run.set_defaults(fn=run_fn)
+        for action, fn, needs_name in (
+                ("status", status_fn, True), ("retrieve", retrieve_fn,
+                                              True),
+                ("list", list_fn, False), ("delete", delete_fn, True)):
+            sp = gsub.add_parser(action)
+            if needs_name:
+                sp.add_argument("name")
+            if action == "retrieve":
+                sp.add_argument("-f", "--file", default="")
+            sp.set_defaults(fn=fn)
+
+    npr = sub.add_parser("policy-recommendation", aliases=["pr"])
+
+    def npr_flags(run):
+        run.add_argument("-t", "--type", default="initial",
+                         choices=["initial", "subsequent"])
+        run.add_argument("-l", "--limit", type=int, default=0)
+        run.add_argument("-p", "--policy-type", dest="policy_type",
+                         default="anp-deny-applied",
+                         choices=["anp-deny-applied", "anp-deny-all",
+                                  "k8s-np"])
+        run.add_argument("-s", "--start-time", dest="start_time",
+                         default="")
+        run.add_argument("-e", "--end-time", dest="end_time", default="")
+        run.add_argument("-n", "--ns-allow-list", dest="ns_allow_list",
+                         default="")
+        run.add_argument("--exclude-labels", dest="exclude_labels",
+                         type=lambda v: v != "false", default=True)
+        run.add_argument("--to-services", dest="to_services",
+                         type=lambda v: v != "false", default=True)
+        run.add_argument("--executor-instances",
+                         dest="executor_instances", type=int, default=1)
+
+    add_job_commands(npr, npr_run, npr_status, npr_retrieve, npr_list,
+                     npr_delete, npr_flags)
+
+    tad = sub.add_parser("throughput-anomaly-detection", aliases=["tad"])
+
+    def tad_flags(run):
+        run.add_argument("-a", "--algo", required=True,
+                         choices=["EWMA", "ARIMA", "DBSCAN"])
+        run.add_argument("-s", "--start-time", dest="start_time",
+                         default="")
+        run.add_argument("-e", "--end-time", dest="end_time", default="")
+        run.add_argument("-n", "--ns-ignore-list", dest="ns_ignore_list",
+                         default="")
+        run.add_argument("--agg-flow", dest="agg_flow", default="",
+                         choices=["", "pod", "external", "svc"])
+        run.add_argument("--pod-label", dest="pod_label", default="")
+        run.add_argument("--pod-name", dest="pod_name", default="")
+        run.add_argument("--pod-namespace", dest="pod_namespace",
+                         default="")
+        run.add_argument("--external-ip", dest="external_ip", default="")
+        run.add_argument("--svc-port-name", dest="svc_port_name",
+                         default="")
+        run.add_argument("--executor-instances",
+                         dest="executor_instances", type=int, default=1)
+
+    add_job_commands(tad, tad_run, tad_status, tad_retrieve, tad_list,
+                     tad_delete, tad_flags)
+
+    ch = sub.add_parser("clickhouse")
+    chsub = ch.add_subparsers(dest="action", required=True)
+    status = chsub.add_parser("status")
+    status.add_argument("--diskInfo", action="store_true")
+    status.add_argument("--tableInfo", action="store_true")
+    status.add_argument("--insertRate", action="store_true")
+    status.add_argument("--stackTraces", action="store_true")
+    status.set_defaults(fn=clickhouse_status)
+
+    sb = sub.add_parser("supportbundle")
+    sb.add_argument("-f", "--file", default="")
+    sb.set_defaults(fn=supportbundle)
+
+    ver = sub.add_parser("version")
+    ver.set_defaults(fn=version)
+    return p
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    try:
+        args.fn(args)
+    except BrokenPipeError:
+        # stdout consumer (e.g. `| head`) closed the pipe — exit quietly
+        try:
+            sys.stdout.close()
+        except Exception:
+            pass
+        raise SystemExit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,25 @@
+"""Control plane: job controllers + aggregated REST API."""
+
+from .api import API_PORT, TheiaManagerServer
+from .jobs import (
+    KIND_NPR,
+    KIND_TAD,
+    STATE_COMPLETED,
+    STATE_FAILED,
+    STATE_NEW,
+    STATE_RUNNING,
+    STATE_SCHEDULED,
+    JobController,
+    JobRecord,
+    job_id_from_name,
+)
+from .stats import StatsProvider
+
+__all__ = [
+    "API_PORT", "TheiaManagerServer",
+    "JobController", "JobRecord", "job_id_from_name",
+    "KIND_NPR", "KIND_TAD",
+    "STATE_NEW", "STATE_SCHEDULED", "STATE_RUNNING", "STATE_COMPLETED",
+    "STATE_FAILED",
+    "StatsProvider",
+]

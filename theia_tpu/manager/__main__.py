@@ -1,0 +1,64 @@
+"""Run the theia-manager: REST API + job controllers over a FlowDatabase.
+
+Usage:
+  python -m theia_tpu.manager [--db flows.npz] [--port 11347]
+      [--capacity-bytes N] [--synth N_SERIES]
+
+--synth seeds the store with synthetic flows (demo/e2e); --db loads a
+persisted FlowDatabase (and persists results back on shutdown).
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(prog="theia_tpu.manager")
+    p.add_argument("--db", default=None, help="FlowDatabase .npz path")
+    p.add_argument("--port", type=int, default=None)
+    p.add_argument("--capacity-bytes", type=int, default=8 << 30)
+    p.add_argument("--workers", type=int, default=2)
+    p.add_argument("--synth", type=int, default=0,
+                   help="seed the store with N synthetic series")
+    args = p.parse_args(argv)
+
+    from ..store import FlowDatabase
+    from .api import API_PORT, TheiaManagerServer
+
+    if args.db:
+        try:
+            db = FlowDatabase.load(args.db)
+        except FileNotFoundError:
+            db = FlowDatabase()
+    else:
+        db = FlowDatabase()
+    if args.synth:
+        from ..data.synth import SynthConfig, generate_flows
+        db.insert_flows(generate_flows(SynthConfig(
+            n_series=args.synth, points_per_series=30,
+            anomaly_fraction=0.1)))
+
+    server = TheiaManagerServer(
+        db, port=args.port if args.port is not None else API_PORT,
+        workers=args.workers, capacity_bytes=args.capacity_bytes)
+    print(f"theia-manager listening on :{server.port}", file=sys.stderr)
+
+    def stop(*_):
+        # shutdown() must not run on the thread executing
+        # serve_forever() (BaseServer.shutdown would deadlock); hand it
+        # to a helper thread and let serve_forever return below.
+        import threading
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGINT, stop)
+    signal.signal(signal.SIGTERM, stop)
+    server.serve_forever()
+    if args.db:
+        db.save(args.db)
+
+
+if __name__ == "__main__":
+    main()

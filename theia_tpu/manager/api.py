@@ -1,0 +1,358 @@
+"""theia-manager REST API.
+
+Re-provides the reference's aggregated API server
+(pkg/apiserver/apiserver.go:131-162 installs three groups) on the same
+port (TheiaManagerAPIPort = 11347, pkg/apis/ports.go:7):
+
+  intelligence.theia.antrea.io/v1alpha1
+      networkpolicyrecommendations, throughputanomalydetectors
+      (registry/intelligence/*/rest.go — Get/List/Create/Delete; Get of
+      a COMPLETED job attaches results from the store)
+  stats.theia.antrea.io/v1alpha1
+      clickhouse (+ /diskInfo /tableInfo /insertRate /stackTraces)
+  system.theia.antrea.io/v1alpha1
+      supportbundles (async collect + download, reference
+      registry/system/supportbundle/rest.go)
+
+Serialization is the same JSON shape the reference's k8s types marshal
+to (pkg/apis/intelligence/v1alpha1/types.go), so the CLI talks to either
+server. Transport is plain HTTP on a ThreadingHTTPServer; the
+reference's delegated authn/TLS sits in front of an equivalent seam.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import tarfile
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+
+from .jobs import (
+    KIND_NPR,
+    KIND_TAD,
+    STATE_COMPLETED,
+    DuplicateJobError,
+    JobController,
+    JobRecord,
+)
+from .stats import StatsProvider
+
+API_PORT = 11347
+
+GROUP_INTELLIGENCE = "/apis/intelligence.theia.antrea.io/v1alpha1"
+GROUP_STATS = "/apis/stats.theia.antrea.io/v1alpha1"
+GROUP_SYSTEM = "/apis/system.theia.antrea.io/v1alpha1"
+
+_RESOURCE_KIND = {
+    "networkpolicyrecommendations": KIND_NPR,
+    "throughputanomalydetectors": KIND_TAD,
+}
+_KIND_NAMES = {
+    KIND_NPR: "NetworkPolicyRecommendation",
+    KIND_TAD: "ThroughputAnomalyDetector",
+}
+
+
+def record_to_api(record: JobRecord, controller: JobController,
+                  with_result: bool = False) -> Dict[str, object]:
+    doc: Dict[str, object] = {
+        "kind": _KIND_NAMES[record.kind],
+        "apiVersion": "intelligence.theia.antrea.io/v1alpha1",
+        "metadata": {"name": record.name},
+        "status": record.status_dict(),
+    }
+    doc.update(record.spec)
+    if with_result and record.state == STATE_COMPLETED:
+        if record.kind == KIND_NPR:
+            doc["status"]["recommendationOutcome"] = (  # type: ignore
+                controller.recommendation_outcome(record.name))
+        else:
+            doc["stats"] = controller.tad_stats(record.name)
+    return doc
+
+
+class SupportBundleManager:
+    """Async support-bundle collection (reference supportBundleREST:
+    Create spawns a collect goroutine, status polls, then download —
+    rest.go:115-255,425)."""
+
+    def __init__(self, controller: JobController,
+                 stats: StatsProvider) -> None:
+        self.controller = controller
+        self.stats = stats
+        self.status = "none"
+        self._data: Optional[bytes] = None
+        self._lock = threading.Lock()
+
+    def create(self) -> Dict[str, object]:
+        with self._lock:
+            if self.status == "collecting":
+                return self.to_api()
+            self.status = "collecting"
+        threading.Thread(target=self._collect, daemon=True).start()
+        return self.to_api()
+
+    def _collect(self) -> None:
+        buf = io.BytesIO()
+        try:
+            with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+                def add(name: str, payload: str) -> None:
+                    raw = payload.encode()
+                    info = tarfile.TarInfo(name)
+                    info.size = len(raw)
+                    info.mtime = int(time.time())
+                    tar.addfile(info, io.BytesIO(raw))
+
+                add("stats/diskInfo.json",
+                    json.dumps(self.stats.disk_infos(), indent=2))
+                add("stats/tableInfo.json",
+                    json.dumps(self.stats.table_infos(), indent=2))
+                add("stats/stackTraces.json",
+                    json.dumps(self.stats.stack_traces(), indent=2))
+                add("jobs.json", json.dumps(
+                    [record_to_api(r, self.controller)
+                     for r in self.controller.list()], indent=2,
+                    default=str))
+            with self._lock:
+                self._data = buf.getvalue()
+                self.status = "collected"
+        except Exception:
+            with self._lock:
+                self.status = "none"
+            raise
+
+    def to_api(self) -> Dict[str, object]:
+        with self._lock:
+            size = len(self._data) if self._data else 0
+            return {
+                "kind": "SupportBundle",
+                "apiVersion": "system.theia.antrea.io/v1alpha1",
+                "metadata": {"name": "theia-manager"},
+                "status": self.status,
+                "size": size,
+            }
+
+    def data(self) -> Optional[bytes]:
+        with self._lock:
+            return self._data
+
+
+class ManagerAPIHandler(BaseHTTPRequestHandler):
+    server_version = "theia-tpu-manager/0.2"
+    controller: JobController
+    stats: StatsProvider
+    bundles: SupportBundleManager
+    quiet = True
+
+    def log_message(self, fmt, *args):  # noqa: N802
+        if not self.quiet:
+            super().log_message(fmt, *args)
+
+    # -- helpers ---------------------------------------------------------
+
+    def _send_json(self, doc, code: int = 200) -> None:
+        raw = json.dumps(doc, default=str).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(raw)))
+        self.end_headers()
+        self.wfile.write(raw)
+
+    def _send_error_json(self, code: int, message: str) -> None:
+        self._send_json({"kind": "Status", "status": "Failure",
+                         "message": message, "code": code}, code)
+
+    def _read_body(self) -> Dict[str, object]:
+        length = int(self.headers.get("Content-Length", 0))
+        if not length:
+            return {}
+        return json.loads(self.rfile.read(length))
+
+    def _route(self) -> Tuple[str, ...]:
+        return tuple(p for p in self.path.split("?")[0].split("/") if p)
+
+    # -- verbs -----------------------------------------------------------
+
+    def do_GET(self) -> None:  # noqa: N802
+        try:
+            self._get()
+        except KeyError:
+            self._send_error_json(404, f"not found: {self.path}")
+        except Exception as e:  # surface handler bugs as 500s
+            self._send_error_json(500, f"{type(e).__name__}: {e}")
+
+    def do_POST(self) -> None:  # noqa: N802
+        try:
+            self._post()
+        except DuplicateJobError as e:
+            self._send_error_json(409, str(e))
+        except KeyError:
+            self._send_error_json(404, f"not found: {self.path}")
+        except (ValueError, json.JSONDecodeError) as e:
+            self._send_error_json(400, str(e))
+        except Exception as e:
+            self._send_error_json(500, f"{type(e).__name__}: {e}")
+
+    def do_DELETE(self) -> None:  # noqa: N802
+        try:
+            self._delete()
+        except KeyError:
+            self._send_error_json(404, f"not found: {self.path}")
+        except Exception as e:
+            self._send_error_json(500, f"{type(e).__name__}: {e}")
+
+    # -- routing ---------------------------------------------------------
+
+    def _get(self) -> None:
+        parts = self._route()
+        if parts == ("healthz",):
+            self._send_json({"status": "ok"})
+            return
+        if parts == ("version",):
+            from .. import __version__
+            self._send_json({"version": __version__})
+            return
+        if self.path.startswith(GROUP_INTELLIGENCE):
+            self._get_intelligence(parts)
+            return
+        if self.path.startswith(GROUP_STATS):
+            self._get_stats(parts)
+            return
+        if self.path.startswith(GROUP_SYSTEM):
+            self._get_system(parts)
+            return
+        raise KeyError(self.path)
+
+    def _get_intelligence(self, parts) -> None:
+        resource = parts[3]
+        kind = _RESOURCE_KIND[resource]
+        if len(parts) == 4:   # list
+            items = [record_to_api(r, self.controller)
+                     for r in self.controller.list(kind)]
+            self._send_json({
+                "kind": _KIND_NAMES[kind] + "List",
+                "apiVersion": "intelligence.theia.antrea.io/v1alpha1",
+                "items": items})
+        elif len(parts) == 5:
+            record = self.controller.get(parts[4])
+            if record.kind != kind:
+                raise KeyError(parts[4])
+            self._send_json(record_to_api(record, self.controller,
+                                          with_result=True))
+        else:
+            raise KeyError(self.path)
+
+    _STATS_COMPONENTS = ("diskInfo", "tableInfo", "insertRate",
+                         "stackTraces")
+
+    def _get_stats(self, parts) -> None:
+        if len(parts) < 4 or parts[3] != "clickhouse":
+            raise KeyError(self.path)
+        component = parts[4] if len(parts) > 4 else None
+        if component is not None and \
+                component not in self._STATS_COMPONENTS:
+            raise KeyError(self.path)
+        doc: Dict[str, object] = {
+            "kind": "ClickHouseStats",
+            "apiVersion": "stats.theia.antrea.io/v1alpha1",
+        }
+        if component in (None, "diskInfo"):
+            doc["diskInfos"] = self.stats.disk_infos()
+        if component in (None, "tableInfo"):
+            doc["tableInfos"] = self.stats.table_infos()
+        if component in (None, "insertRate"):
+            doc["insertRates"] = self.stats.insert_rates()
+        if component in (None, "stackTraces"):
+            doc["stackTraces"] = self.stats.stack_traces()
+        self._send_json(doc)
+
+    def _get_system(self, parts) -> None:
+        if len(parts) >= 4 and parts[3] == "supportbundles":
+            if len(parts) == 6 and parts[5] == "download":
+                data = self.bundles.data()
+                if data is None:
+                    raise KeyError("bundle not collected")
+                self.send_response(200)
+                self.send_header("Content-Type", "application/gzip")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+                return
+            self._send_json(self.bundles.to_api())
+            return
+        raise KeyError(self.path)
+
+    def _post(self) -> None:
+        parts = self._route()
+        if self.path.startswith(GROUP_INTELLIGENCE) and len(parts) == 4:
+            kind = _RESOURCE_KIND[parts[3]]
+            body = self._read_body()
+            name = (body.get("metadata") or {}).get("name")
+            spec = {k: v for k, v in body.items()
+                    if k not in ("kind", "apiVersion", "metadata",
+                                 "status", "stats")}
+            record = self.controller.create(kind, spec, name=name)
+            self._send_json(record_to_api(record, self.controller), 201)
+            return
+        if self.path.startswith(GROUP_SYSTEM) and len(parts) >= 4 \
+                and parts[3] == "supportbundles":
+            self._send_json(self.bundles.create(), 201)
+            return
+        raise KeyError(self.path)
+
+    def _delete(self) -> None:
+        parts = self._route()
+        if self.path.startswith(GROUP_INTELLIGENCE) and len(parts) == 5:
+            kind = _RESOURCE_KIND[parts[3]]
+            record = self.controller.get(parts[4])
+            if record.kind != kind:
+                raise KeyError(parts[4])
+            self.controller.delete(parts[4])
+            self._send_json({"kind": "Status", "status": "Success"})
+            return
+        raise KeyError(self.path)
+
+
+class TheiaManagerServer:
+    """Wires controller + stats + bundles into one HTTP server."""
+
+    def __init__(self, db, port: int = API_PORT, workers: int = 2,
+                 capacity_bytes: int = 8 << 30,
+                 address: str = "127.0.0.1") -> None:
+        self.controller = JobController(db, workers=workers)
+        self.stats = StatsProvider(db, capacity_bytes=capacity_bytes)
+        self.bundles = SupportBundleManager(self.controller, self.stats)
+
+        handler = type("BoundHandler", (ManagerAPIHandler,), {
+            "controller": self.controller,
+            "stats": self.stats,
+            "bundles": self.bundles,
+        })
+        self.httpd = ThreadingHTTPServer((address, port), handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+        self._serving = False
+
+    def start_background(self) -> None:
+        self._serving = True
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True,
+            name="theia-manager-api")
+        self._thread.start()
+
+    def serve_forever(self) -> None:
+        self._serving = True
+        self.httpd.serve_forever()
+
+    def shutdown(self) -> None:
+        # BaseServer.shutdown() blocks forever unless serve_forever is
+        # running — guard so a never-started server can still shut down.
+        if self._serving:
+            self.httpd.shutdown()
+        self.httpd.server_close()
+        self.controller.shutdown()
+        if self._thread:
+            self._thread.join(timeout=2)

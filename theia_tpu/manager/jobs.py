@@ -1,0 +1,291 @@
+"""Job records + controller state machine for NPR/TAD jobs.
+
+Re-provides the reference's CRD controllers
+(pkg/controller/networkpolicyrecommendation/controller.go and
+pkg/controller/anomalydetector/controller.go): a job CR moves through
+NEW → SCHEDULED → RUNNING → COMPLETED/FAILED (state machine
+controller.go:375-427), with progress scraped into status while RUNNING
+(:429-456), results garbage-collected when the CR is deleted
+(cleanupNPRecommendation :390-403), and stale result rows reconciled
+against live CRs at startup (HandleStaleDbEntries util.go:239-270).
+
+Instead of submitting SparkApplications to an operator, the controller
+runs the analytics jobs on worker threads against the shared
+FlowDatabase — the TPU engine is in-process; scheduling is a thread
+pool, not a pod fleet.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+import traceback
+import uuid
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..analytics import TadQuerySpec, run_npr, run_tad
+from ..runner.progress import NPR_STAGES, TAD_STAGES, JobProgress
+from ..store import FlowDatabase
+
+STATE_NEW = "NEW"
+STATE_SCHEDULED = "SCHEDULED"
+STATE_RUNNING = "RUNNING"
+STATE_COMPLETED = "COMPLETED"
+STATE_FAILED = "FAILED"
+
+KIND_NPR = "npr"
+KIND_TAD = "tad"
+
+_NAME_PREFIX = {KIND_NPR: "pr-", KIND_TAD: "tad-"}
+
+
+class DuplicateJobError(Exception):
+    """A job with this name already exists (→ HTTP 409)."""
+
+
+def job_id_from_name(kind: str, name: str) -> str:
+    """pr-<uuid> / tad-<uuid> → <uuid> (reference ParseRecommendationName
+    / ParseADAlgorithmName, pkg/util/utils.go)."""
+    prefix = _NAME_PREFIX[kind]
+    if not name.startswith(prefix):
+        raise ValueError(
+            f"invalid {kind} job name {name!r}: expected prefix {prefix}")
+    suffix = name[len(prefix):]
+    uuid.UUID(suffix)  # raises on malformed id
+    return suffix
+
+
+@dataclasses.dataclass
+class JobRecord:
+    name: str
+    kind: str                      # KIND_NPR | KIND_TAD
+    spec: Dict[str, object]
+    state: str = STATE_NEW
+    error_msg: str = ""
+    start_time: float = 0.0
+    end_time: float = 0.0
+    progress: Optional[JobProgress] = None
+
+    @property
+    def job_id(self) -> str:
+        return job_id_from_name(self.kind, self.name)
+
+    def status_dict(self) -> Dict[str, object]:
+        completed, total = 0, 0
+        if self.progress is not None:
+            snap = self.progress.snapshot()
+            completed = snap["completedStages"]
+            total = snap["totalStages"]
+        return {
+            "state": self.state,
+            "sparkApplication": self.job_id,
+            "completedStages": completed,
+            "totalStages": total,
+            "errorMsg": self.error_msg,
+            "startTime": self.start_time,
+            "endTime": self.end_time,
+        }
+
+
+class JobController:
+    """Reconciles job records into analytics runs over a worker pool."""
+
+    def __init__(self, db: FlowDatabase, workers: int = 2) -> None:
+        self.db = db
+        self._records: Dict[str, JobRecord] = {}
+        self._lock = threading.Lock()
+        self._queue: "queue.Queue[str]" = queue.Queue()
+        self._stop = threading.Event()
+        self._threads = [
+            threading.Thread(target=self._worker, daemon=True,
+                             name=f"job-worker-{i}")
+            for i in range(workers)]
+        for t in self._threads:
+            t.start()
+        self.gc_stale_results()
+
+    # -- CRUD ------------------------------------------------------------
+
+    def create(self, kind: str, spec: Dict[str, object],
+               name: Optional[str] = None) -> JobRecord:
+        if name is None:
+            name = _NAME_PREFIX[kind] + str(uuid.uuid4())
+        job_id_from_name(kind, name)  # validate
+        record = JobRecord(name=name, kind=kind, spec=dict(spec),
+                           state=STATE_SCHEDULED)
+        with self._lock:
+            if name in self._records:
+                raise DuplicateJobError(f"job {name} already exists")
+            self._records[name] = record
+        self._queue.put(name)
+        return record
+
+    def get(self, name: str) -> JobRecord:
+        with self._lock:
+            return self._records[name]
+
+    def list(self, kind: Optional[str] = None) -> List[JobRecord]:
+        with self._lock:
+            records = list(self._records.values())
+        if kind:
+            records = [r for r in records if r.kind == kind]
+        return records
+
+    def delete(self, name: str) -> None:
+        """Remove the CR and GC its result rows (reference
+        cleanupNPRecommendation deletes recommendations by id)."""
+        with self._lock:
+            record = self._records.pop(name)
+        self._delete_results(record.kind, record.job_id)
+
+    # -- GC --------------------------------------------------------------
+
+    def gc_stale_results(self) -> int:
+        """Drop result rows whose job CR no longer exists (reference
+        HandleStaleDbEntries, run from the controller gcQueue at
+        startup)."""
+        with self._lock:
+            live = {r.job_id for r in self._records.values()}
+        removed = 0
+        for table in (self.db.recommendations, self.db.tadetector):
+            data = table.scan()
+            if not len(data):
+                continue
+            ids = data.strings("id")
+            stale = ~np.isin(ids, list(live)) if live else np.ones(
+                len(ids), bool)
+            if stale.any():
+                removed += table.delete_where(stale)
+        return removed
+
+    def _delete_results(self, kind: str, job_id: str) -> None:
+        table = (self.db.recommendations if kind == KIND_NPR
+                 else self.db.tadetector)
+        data = table.scan()
+        if len(data):
+            table.delete_where(data.strings("id") == job_id)
+
+    # -- result retrieval ------------------------------------------------
+
+    def recommendation_outcome(self, name: str) -> str:
+        """Joined policy YAML for a COMPLETED NPR job (reference
+        getRecommendationResult joins rows with '---\\n', rest.go:213)."""
+        job_id = job_id_from_name(KIND_NPR, name)
+        data = self.db.recommendations.scan()
+        if not len(data):
+            return ""
+        rows = data.filter(data.strings("id") == job_id)
+        return "---\n".join(rows.strings("policy"))
+
+    def tad_stats(self, name: str) -> List[Dict[str, str]]:
+        """tadetector rows for a TAD job as string-typed stat entries
+        (reference getTADetectorResult, rest.go:249-310)."""
+        job_id = job_id_from_name(KIND_TAD, name)
+        data = self.db.tadetector.scan()
+        if not len(data):
+            return []
+        rows = data.filter(data.strings("id") == job_id)
+        return [{k: str(v) for k, v in row.items()}
+                for row in rows.to_rows()]
+
+    # -- workers ---------------------------------------------------------
+
+    def _worker(self) -> None:
+        while not self._stop.is_set():
+            try:
+                name = self._queue.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            try:
+                with self._lock:
+                    record = self._records.get(name)
+                if record is None:    # deleted before it ran
+                    continue
+                self._run(record)
+            finally:
+                self._queue.task_done()
+
+    def _run(self, record: JobRecord) -> None:
+        record.state = STATE_RUNNING
+        record.start_time = time.time()
+        try:
+            if record.kind == KIND_TAD:
+                record.progress = JobProgress(record.job_id, TAD_STAGES)
+                spec = record.spec
+                run_tad(
+                    self.db, str(spec.get("jobType", "EWMA")),
+                    TadQuerySpec(
+                        start_time=spec.get("startInterval") or None,
+                        end_time=spec.get("endInterval") or None,
+                        ns_ignore_list=spec.get("nsIgnoreList") or (),
+                        agg_flow=str(spec.get("aggFlow", "") or ""),
+                        pod_label=str(spec.get("podLabel", "") or ""),
+                        pod_name=str(spec.get("podName", "") or ""),
+                        pod_namespace=str(
+                            spec.get("podNameSpace", "") or ""),
+                        external_ip=str(spec.get("externalIp", "") or ""),
+                        svc_port_name=str(
+                            spec.get("servicePortName", "") or "")),
+                    tad_id=record.job_id,
+                    progress=record.progress)
+            else:
+                record.progress = JobProgress(record.job_id, NPR_STAGES)
+                spec = record.spec
+                policy_type = str(spec.get("policyType",
+                                           "anp-deny-applied"))
+                option = {"anp-deny-applied": 1, "anp-deny-all": 2,
+                          "k8s-np": 3}.get(policy_type)
+                if option is None:
+                    raise ValueError(
+                        f"invalid policyType {policy_type!r}")
+                run_npr(
+                    self.db,
+                    recommendation_type=str(spec.get("jobType",
+                                                     "initial")),
+                    limit=int(spec.get("limit", 0) or 0),
+                    option=option,
+                    start_time=spec.get("startInterval") or None,
+                    end_time=spec.get("endInterval") or None,
+                    ns_allow_list=spec.get("nsAllowList") or None,
+                    rm_labels=bool(spec.get("excludeLabels", True)),
+                    to_services=bool(spec.get("toServices", True)),
+                    recommendation_id=record.job_id,
+                    progress=record.progress)
+            record.state = STATE_COMPLETED
+        except Exception as e:   # job failure → FAILED CR status
+            record.state = STATE_FAILED
+            record.error_msg = f"{type(e).__name__}: {e}"
+            if record.progress:
+                record.progress.fail(record.error_msg)
+            traceback.print_exc()
+        finally:
+            record.end_time = time.time()
+            # If the CR was deleted while the job ran, its result rows
+            # were written after delete()'s GC — clean them up now so
+            # in-flight deletes keep the reference's cleanup semantics.
+            with self._lock:
+                deleted = record.name not in self._records
+            if deleted:
+                self._delete_results(record.kind, record.job_id)
+
+    def wait_all(self, timeout: float = 60.0) -> bool:
+        """Test/CLI helper: block until the queue drains and no job is
+        RUNNING."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with self._lock:
+                busy = any(r.state in (STATE_SCHEDULED, STATE_RUNNING)
+                           for r in self._records.values())
+            if not busy and self._queue.empty():
+                return True
+            time.sleep(0.05)
+        return False
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2)

@@ -1,0 +1,101 @@
+"""Store statistics — the ClickHouseStats API equivalent.
+
+Re-provides pkg/apiserver/utils/stats/clickhouse_stats.go:35-117, whose
+four canned queries read system.disks / system.tables / system.query_log
+/ system.stack_trace. Here the "shard" is the in-process store:
+
+  * diskInfos   — store bytes vs a configured capacity
+  * tableInfos  — rows/bytes/columns per table and materialized view
+  * insertRates — rows/s and bytes/s since the previous sample
+  * stackTraces — current Python thread stacks (the reference dumps
+                  ClickHouse thread stacks)
+
+String-typed values mirror the reference API (pkg/apis/stats/v1alpha1).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from typing import Dict, List
+
+from ..store import FlowDatabase
+
+
+class StatsProvider:
+    def __init__(self, db: FlowDatabase,
+                 capacity_bytes: int = 8 << 30,
+                 shard: str = "0") -> None:
+        self.db = db
+        self.capacity_bytes = capacity_bytes
+        self.shard = shard
+        self._lock = threading.Lock()
+        self._last_sample = (time.time(), self._row_byte_totals())
+
+    def _row_byte_totals(self):
+        rows = len(self.db.flows)
+        nbytes = self.db.flows.nbytes
+        return rows, nbytes
+
+    def disk_infos(self) -> List[Dict[str, str]]:
+        used = (self.db.flows.nbytes + self.db.tadetector.nbytes
+                + self.db.recommendations.nbytes)
+        free = max(self.capacity_bytes - used, 0)
+        return [{
+            "shard": self.shard,
+            "name": "default",
+            "path": "memory://flows",
+            "freeSpace": str(free),
+            "totalSpace": str(self.capacity_bytes),
+            "usedPercentage": f"{used / self.capacity_bytes * 100:.2f}",
+        }]
+
+    def table_infos(self) -> List[Dict[str, str]]:
+        out = []
+        for table in (self.db.flows, self.db.tadetector,
+                      self.db.recommendations):
+            out.append({
+                "shard": self.shard,
+                "database": "default",
+                "tableName": table.name,
+                "totalRows": str(len(table)),
+                "totalBytes": str(table.nbytes),
+                "totalCols": str(len(table.schema)),
+            })
+        for name, view in self.db.views.items():
+            batch = view.scan()
+            nbytes = sum(v.nbytes for v in batch.columns.values())
+            out.append({
+                "shard": self.shard,
+                "database": "default",
+                "tableName": name,
+                "totalRows": str(len(batch)),
+                "totalBytes": str(nbytes),
+                "totalCols": str(len(batch.columns)),
+            })
+        return out
+
+    def insert_rates(self) -> List[Dict[str, str]]:
+        now = time.time()
+        rows, nbytes = self._row_byte_totals()
+        with self._lock:
+            then, (prev_rows, prev_bytes) = self._last_sample
+            self._last_sample = (now, (rows, nbytes))
+        dt = max(now - then, 1e-9)
+        return [{
+            "shard": self.shard,
+            "rowsPerSec": str(int(max(rows - prev_rows, 0) / dt)),
+            "bytesPerSec": str(int(max(nbytes - prev_bytes, 0) / dt)),
+        }]
+
+    def stack_traces(self) -> List[Dict[str, str]]:
+        out = []
+        for tid, frame in sys._current_frames().items():
+            out.append({
+                "shard": self.shard,
+                "threadId": str(tid),
+                "trace": "".join(traceback.format_stack(frame, limit=12)),
+            })
+        return out
