@@ -82,6 +82,40 @@ def main() -> None:
           f"({x.nbytes / step_s / 1e9:.1f} GB/s effective)",
           file=sys.stderr)
 
+    # Secondary diagnostics (stderr): native ingest rate + streaming
+    # alert latency on this chip.
+    try:
+        from theia_tpu.ingest import TsvDecoder, encode_tsv, \
+            native_available
+        if native_available():
+            payload = encode_tsv(batch) * 8
+            dec = TsvDecoder()
+            dec.decode(payload[:20000])
+            t7 = time.perf_counter()
+            decoded = dec.decode(payload)
+            t8 = time.perf_counter()
+            print(f"native ingest: {len(decoded) / (t8 - t7):,.0f} "
+                  f"rows/s", file=sys.stderr)
+    except Exception as e:
+        print(f"ingest bench skipped: {e}", file=sys.stderr)
+
+    try:
+        from theia_tpu.analytics.streaming import StreamingDetector
+        det = StreamingDetector(capacity=1024)
+        S, T = cfg.n_series, cfg.points_per_series
+        idx = np.arange(len(batch)).reshape(S, T)
+        lat = []
+        for t in range(min(T, 40)):
+            micro = batch.take(idx[:, t])
+            t9 = time.perf_counter()
+            det.ingest(micro)
+            lat.append(time.perf_counter() - t9)
+        p50 = sorted(lat)[len(lat) // 2]
+        print(f"streaming micro-batch p50: {p50 * 1e3:.2f} ms "
+              f"({S} series/batch)", file=sys.stderr)
+    except Exception as e:
+        print(f"streaming bench skipped: {e}", file=sys.stderr)
+
     print(json.dumps({
         "metric": "tad_ewma_scoring_records_per_sec",
         "value": round(records_per_sec),

@@ -1,0 +1,186 @@
+// flowblock — native columnar ingest for theia_tpu.
+//
+// Plays the role of the reference's native ingest tier (ClickHouse's C++
+// TabSeparated/native-protocol parsers receiving FlowAggregator inserts;
+// schema contract build/charts/theia/provisioning/datasources/
+// create_table.sh:31-84): decode TSV flow records straight into
+// fixed-width columnar buffers with per-column dictionary encoding, so
+// Python never touches row objects and the arrays are ready for
+// jax.device_put.
+//
+// C API (ctypes-friendly, no C++ types across the boundary):
+//   fb_new(n_cols, kinds)        kinds[i]: 0 = int64, 1 = float64,
+//                                2 = dictionary-encoded string
+//   fb_seed(h, col, s, len)      append an existing dictionary entry
+//                                (call in code order to mirror Python)
+//   fb_decode(h, buf, nbytes, max_rows, out_ints, out_codes)
+//                                parse rows; column-major outputs:
+//                                out_ints [n_numeric][max_rows],
+//                                out_codes [n_string][max_rows];
+//                                returns rows decoded, or -1-row_index
+//                                on a malformed row
+//   fb_dict_size(h, col)         current dictionary size
+//   fb_dict_get(h, col, idx, &len) read one dictionary entry (for
+//                                syncing codes minted here back into
+//                                the Python StringDictionary)
+//   fb_free(h)
+//
+// Build: g++ -O3 -shared -fPIC (driven by theia_tpu/ingest/native.py).
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+enum Kind : int32_t { kInt = 0, kFloat = 1, kString = 2 };
+
+struct Dict {
+  // Stored strings own the bytes; the map's string_views point into
+  // them. std::deque never reallocates existing elements.
+  std::deque<std::string> strings;
+  std::unordered_map<std::string_view, int32_t> to_code;
+
+  Dict() { add("", 0); }
+
+  void add(std::string_view s, int32_t code) {
+    strings.emplace_back(s);
+    to_code.emplace(std::string_view(strings.back()), code);
+  }
+
+  int32_t encode(std::string_view s) {
+    auto it = to_code.find(s);
+    if (it != to_code.end()) return it->second;
+    int32_t code = static_cast<int32_t>(strings.size());
+    add(s, code);
+    return code;
+  }
+};
+
+struct Decoder {
+  std::vector<int32_t> kinds;
+  // per-column slot within its kind group (numeric vs string)
+  std::vector<int32_t> slot;
+  int32_t n_numeric = 0;
+  int32_t n_string = 0;
+  std::vector<Dict> dicts;  // indexed by string slot
+};
+
+inline bool parse_int(const char* b, const char* e, int64_t* out) {
+  if (b == e) { *out = 0; return true; }
+  bool neg = false;
+  if (*b == '-') { neg = true; ++b; }
+  int64_t v = 0;
+  for (; b != e; ++b) {
+    if (*b < '0' || *b > '9') return false;
+    v = v * 10 + (*b - '0');
+  }
+  *out = neg ? -v : v;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* fb_new(int32_t n_cols, const int32_t* kinds) {
+  auto* d = new Decoder();
+  d->kinds.assign(kinds, kinds + n_cols);
+  d->slot.resize(n_cols);
+  for (int32_t i = 0; i < n_cols; ++i) {
+    if (kinds[i] == kString) {
+      d->slot[i] = d->n_string++;
+      d->dicts.emplace_back();
+    } else {
+      d->slot[i] = d->n_numeric++;
+    }
+  }
+  return d;
+}
+
+void fb_seed(void* h, int32_t col, const char* s, int64_t len) {
+  auto* d = static_cast<Decoder*>(h);
+  Dict& dict = d->dicts[d->slot[col]];
+  std::string_view sv(s, static_cast<size_t>(len));
+  if (dict.to_code.find(sv) == dict.to_code.end()) {
+    dict.add(sv, static_cast<int32_t>(dict.strings.size()));
+  }
+}
+
+int64_t fb_decode(void* h, const char* buf, int64_t nbytes,
+                  int64_t max_rows, int64_t* out_ints,
+                  int32_t* out_codes) {
+  auto* d = static_cast<Decoder*>(h);
+  const int32_t n_cols = static_cast<int32_t>(d->kinds.size());
+  const char* p = buf;
+  const char* end = buf + nbytes;
+  int64_t row = 0;
+
+  while (p < end && row < max_rows) {
+    const char* line_end =
+        static_cast<const char*>(memchr(p, '\n', end - p));
+    if (line_end == nullptr) line_end = end;
+    if (line_end == p) { ++p; continue; }  // skip blank lines
+
+    const char* f = p;
+    for (int32_t c = 0; c < n_cols; ++c) {
+      const char* f_end = static_cast<const char*>(
+          memchr(f, '\t', line_end - f));
+      if (f_end == nullptr) f_end = line_end;
+      if (c == n_cols - 1) f_end = line_end;
+
+      const int32_t slot = d->slot[c];
+      switch (d->kinds[c]) {
+        case kInt: {
+          int64_t v;
+          if (!parse_int(f, f_end, &v)) return -1 - row;
+          out_ints[slot * max_rows + row] = v;
+          break;
+        }
+        case kFloat: {
+          // stored through the int64 plane; Python reinterprets
+          char tmp[64];
+          size_t n = static_cast<size_t>(f_end - f);
+          if (n >= sizeof(tmp)) return -1 - row;
+          memcpy(tmp, f, n);
+          tmp[n] = 0;
+          double v = (n == 0) ? 0.0 : strtod(tmp, nullptr);
+          memcpy(&out_ints[slot * max_rows + row], &v, sizeof(double));
+          break;
+        }
+        case kString: {
+          std::string_view sv(f, static_cast<size_t>(f_end - f));
+          out_codes[slot * max_rows + row] =
+              d->dicts[slot].encode(sv);
+          break;
+        }
+      }
+      f = (f_end < line_end) ? f_end + 1 : line_end;
+    }
+    ++row;
+    p = (line_end < end) ? line_end + 1 : end;
+  }
+  return row;
+}
+
+int64_t fb_dict_size(void* h, int32_t col) {
+  auto* d = static_cast<Decoder*>(h);
+  return static_cast<int64_t>(d->dicts[d->slot[col]].strings.size());
+}
+
+const char* fb_dict_get(void* h, int32_t col, int64_t idx,
+                        int64_t* len) {
+  auto* d = static_cast<Decoder*>(h);
+  const std::string& s = d->dicts[d->slot[col]].strings[
+      static_cast<size_t>(idx)];
+  *len = static_cast<int64_t>(s.size());
+  return s.data();
+}
+
+void fb_free(void* h) { delete static_cast<Decoder*>(h); }
+
+}  // extern "C"

@@ -2,10 +2,12 @@
 
 from .npr import (NAMESPACE_ALLOW_LIST, read_distinct_flows, run_npr)
 from .series import SeriesBatch, TadQuerySpec, build_series
+from .streaming import StreamingDetector, stream_update
 from .tad import ALGORITHMS, detect_anomalies, run_tad, score_series
 
 __all__ = [
     "SeriesBatch", "TadQuerySpec", "build_series",
     "ALGORITHMS", "detect_anomalies", "run_tad", "score_series",
     "NAMESPACE_ALLOW_LIST", "read_distinct_flows", "run_npr",
+    "StreamingDetector", "stream_update",
 ]

@@ -1,0 +1,5 @@
+"""Ingest paths: wire bytes -> columnar blocks (native C++ + fallback)."""
+
+from .native import TsvDecoder, encode_tsv, native_available
+
+__all__ = ["TsvDecoder", "encode_tsv", "native_available"]

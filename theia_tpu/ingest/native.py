@@ -1,0 +1,264 @@
+"""Native (C++) TSV flow-record decoder, with a pure-Python fallback.
+
+The ingest contract (SURVEY §7 step 2): wire bytes → fixed-width
+columnar arrays + shared string dictionaries, fast enough that the
+storage tier — not the parser — is the bottleneck. The reference leans
+on ClickHouse's C++ parsers for this; here it's native/flowblock.cc
+loaded via ctypes (no pybind11 in the image), compiled on first use
+with g++ -O3.
+
+Wire format: TabSeparated rows in flow-schema column order (the same
+shape a ClickHouse `INSERT ... FORMAT TabSeparated` carries, and what
+`encode_tsv` emits for tests/benchmarks).
+
+Dictionary discipline: the decoder owns per-column hash tables seeded
+from the store's StringDictionary; after each decode the newly minted
+codes are replayed into the Python dictionary in order, so both sides
+agree code-for-code and batches drop into the store with zero
+re-encoding.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..schema import FLOW_SCHEMA, ColumnarBatch, ColumnKind, \
+    StringDictionary
+
+_KIND_CODE = {"int": 0, "float": 1, "string": 2}
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "flowblock.cc")
+_BUILD_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "_build")
+_SO = os.path.join(_BUILD_DIR, "flowblock.so")
+
+_lib_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_error: Optional[str] = None
+
+
+def _column_kind_code(col) -> int:
+    if col.is_string:
+        return _KIND_CODE["string"]
+    if col.kind == ColumnKind.F64:
+        return _KIND_CODE["float"]
+    return _KIND_CODE["int"]
+
+
+def _load_library() -> Optional[ctypes.CDLL]:
+    """Build (if needed) and load the native decoder; None on failure."""
+    global _lib, _build_error
+    with _lib_lock:
+        if _lib is not None or _build_error is not None:
+            return _lib
+        try:
+            os.makedirs(_BUILD_DIR, exist_ok=True)
+            if (not os.path.exists(_SO)
+                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                subprocess.run(
+                    ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                     "-o", _SO, _SRC],
+                    check=True, capture_output=True, text=True)
+            lib = ctypes.CDLL(_SO)
+            lib.fb_new.restype = ctypes.c_void_p
+            lib.fb_new.argtypes = [ctypes.c_int32,
+                                   ctypes.POINTER(ctypes.c_int32)]
+            lib.fb_seed.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                                    ctypes.c_char_p, ctypes.c_int64]
+            lib.fb_decode.restype = ctypes.c_int64
+            lib.fb_decode.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+                ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int32)]
+            lib.fb_dict_size.restype = ctypes.c_int64
+            lib.fb_dict_size.argtypes = [ctypes.c_void_p,
+                                         ctypes.c_int32]
+            lib.fb_dict_get.restype = ctypes.c_void_p
+            lib.fb_dict_get.argtypes = [
+                ctypes.c_void_p, ctypes.c_int32, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int64)]
+            lib.fb_free.argtypes = [ctypes.c_void_p]
+            _lib = lib
+        except (OSError, subprocess.CalledProcessError) as e:
+            detail = getattr(e, "stderr", "") or str(e)
+            _build_error = f"native ingest unavailable: {detail}"
+        return _lib
+
+
+def native_available() -> bool:
+    return _load_library() is not None
+
+
+class TsvDecoder:
+    """Decode TabSeparated flow rows into ColumnarBatches.
+
+    Uses the native decoder when available, else the Python fallback.
+    Dictionaries passed in are kept in sync (codes match exactly).
+    """
+
+    def __init__(self, schema=FLOW_SCHEMA,
+                 dicts: Optional[Dict[str, StringDictionary]] = None,
+                 force_python: bool = False) -> None:
+        self.schema = schema
+        self.dicts = dict(dicts or {})
+        for col in schema:
+            if col.is_string:
+                self.dicts.setdefault(col.name, StringDictionary())
+        self._numeric_cols = [c for c in schema if not c.is_string]
+        self._string_cols = [c for c in schema if c.is_string]
+        self._lib = None if force_python else _load_library()
+        self._handle = None
+        # How many python-dictionary entries the native side has seen,
+        # per column index — lets each decode() replay entries added by
+        # OTHER ingest paths (from_rows, a second decoder) before
+        # parsing, so codes never diverge.
+        self._synced_len: Dict[int, int] = {}
+        if self._lib is not None:
+            kinds = (ctypes.c_int32 * len(schema))(
+                *[_column_kind_code(c) for c in schema])
+            self._handle = self._lib.fb_new(len(schema), kinds)
+            for i, col in enumerate(schema):
+                if col.is_string:
+                    self._synced_len[i] = 0
+            self._push_python_dicts()
+
+    def __del__(self):
+        if getattr(self, "_handle", None) and self._lib is not None:
+            self._lib.fb_free(self._handle)
+            self._handle = None
+
+    @property
+    def is_native(self) -> bool:
+        return self._handle is not None
+
+    def decode(self, payload: bytes,
+               max_rows: Optional[int] = None) -> ColumnarBatch:
+        """Decode a TSV payload. `max_rows` is a hard bound: exceeding
+        it raises (identically on both paths) rather than silently
+        truncating."""
+        n_rows = len(payload.strip(b"\n").split(b"\n")) if payload \
+            else 0
+        if max_rows is not None and n_rows > max_rows:
+            raise ValueError(
+                f"payload has {n_rows} rows, max_rows={max_rows}")
+        if self._handle is not None:
+            return self._decode_native(payload, max(n_rows, 1))
+        return self._decode_python(payload)
+
+    # -- native path -----------------------------------------------------
+
+    def _push_python_dicts(self) -> None:
+        """Seed entries other ingest paths added to the shared Python
+        dictionaries since the last decode; afterwards both sides hold
+        identical code tables (native never leads Python: its minted
+        codes are replayed back in _sync_dicts)."""
+        for i, col in enumerate(self.schema):
+            if not col.is_string:
+                continue
+            d = self.dicts[col.name]
+            start = self._synced_len[i]
+            with d._lock:
+                pending = list(d._strings[start:])
+            for s in pending:
+                raw = s.encode()
+                self._lib.fb_seed(self._handle, i, raw, len(raw))
+            self._synced_len[i] = start + len(pending)
+            native_n = self._lib.fb_dict_size(self._handle, i)
+            if native_n != self._synced_len[i]:
+                raise RuntimeError(
+                    f"dictionary desync on {col.name}: python "
+                    f"{self._synced_len[i]} entries, native {native_n}")
+
+    def _decode_native(self, payload: bytes,
+                       max_rows: int) -> ColumnarBatch:
+        self._push_python_dicts()
+        n_num = len(self._numeric_cols)
+        n_str = len(self._string_cols)
+        # empty, not zeros: the decoder writes every cell of each parsed
+        # row, and only [:n] is read back.
+        ints = np.empty((n_num, max_rows), np.int64)
+        codes = np.empty((n_str, max_rows), np.int32)
+        n = self._lib.fb_decode(
+            self._handle, payload, len(payload), max_rows,
+            ints.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            codes.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        if n < 0:
+            raise ValueError(f"malformed TSV at row {-(n + 1)}")
+        self._sync_dicts()
+        cols: Dict[str, np.ndarray] = {}
+        num_i = str_i = 0
+        for col in self.schema:
+            if col.is_string:
+                cols[col.name] = codes[str_i, :n].copy()
+                str_i += 1
+            elif col.kind == ColumnKind.F64:
+                cols[col.name] = ints[num_i, :n].view(np.float64).copy()
+                num_i += 1
+            else:
+                cols[col.name] = ints[num_i, :n].astype(col.host_dtype)
+                num_i += 1
+        return ColumnarBatch(cols, self.dicts)
+
+    def _sync_dicts(self) -> None:
+        """Replay codes minted by the native decoder into the Python
+        dictionaries, preserving code order."""
+        for i, col in enumerate(self.schema):
+            if not col.is_string:
+                continue
+            d = self.dicts[col.name]
+            native_n = self._lib.fb_dict_size(self._handle, i)
+            for idx in range(self._synced_len[i], native_n):
+                ln = ctypes.c_int64()
+                ptr = self._lib.fb_dict_get(self._handle, i, idx,
+                                            ctypes.byref(ln))
+                s = ctypes.string_at(ptr, ln.value).decode()
+                code = d.encode_one(s)
+                if code != idx:
+                    raise RuntimeError(
+                        f"dictionary desync on {col.name}: {s!r} -> "
+                        f"{code}, native {idx}")
+            self._synced_len[i] = native_n
+
+    # -- python fallback -------------------------------------------------
+
+    def _decode_python(self, payload: bytes) -> ColumnarBatch:
+        lines = [ln for ln in payload.split(b"\n") if ln]
+        n = len(lines)
+        fields = [ln.split(b"\t") for ln in lines]
+        cols: Dict[str, np.ndarray] = {}
+        for i, col in enumerate(self.schema):
+            raw = [f[i] if i < len(f) else b"" for f in fields]
+            if col.is_string:
+                d = self.dicts[col.name]
+                cols[col.name] = d.encode(
+                    [r.decode() for r in raw]) if n else np.zeros(
+                        0, np.int32)
+            elif col.kind == ColumnKind.F64:
+                cols[col.name] = np.asarray(
+                    [float(r) if r else 0.0 for r in raw], np.float64)
+            else:
+                cols[col.name] = np.asarray(
+                    [int(r) if r else 0 for r in raw], col.host_dtype)
+        return ColumnarBatch(cols, self.dicts)
+
+
+def encode_tsv(batch: ColumnarBatch, schema=FLOW_SCHEMA) -> bytes:
+    """Render a batch as TabSeparated wire bytes (tests/benchmarks)."""
+    columns = []
+    for col in schema:
+        if col.is_string:
+            columns.append(batch.strings(col.name))
+        else:
+            columns.append(batch[col.name])
+    rows = []
+    for i in range(len(batch)):
+        rows.append("\t".join(str(c[i]) for c in columns))
+    return ("\n".join(rows) + "\n").encode()
