@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Render the flow-visibility deployment manifest.
+
+Counterpart of the reference's hack/generate-manifest.sh (options
+--spark-operator/--theia-manager/--no-grafana/--ch-size/
+--ch-monitor-threshold): emits a single Kubernetes YAML deploying the
+theia-tpu stack into the `flow-visibility` namespace. There is no
+ClickHouse operator, ZooKeeper, Grafana or Spark operator to deploy —
+the store, dashboards and analytics engine live inside the manager
+process; the runner image exists for out-of-process batch jobs on TPU
+node pools.
+
+Usage:
+  python deploy/generate_manifest.py [--no-manager] [--tls]
+      [--capacity-bytes N] [--ttl-seconds N] [--namespace NS]
+      > flow-visibility.yml
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def manifest(namespace: str, manager: bool, tls: bool,
+             capacity_bytes: int, ttl_seconds: int,
+             image: str) -> str:
+    docs = [f"""\
+apiVersion: v1
+kind: Namespace
+metadata:
+  name: {namespace}
+  labels:
+    app: theia-tpu
+"""]
+    if manager:
+        tls_args = """
+            - --tls-cert-dir
+            - /certs""" if tls else ""
+        docs.append(f"""\
+apiVersion: apps/v1
+kind: Deployment
+metadata:
+  name: theia-manager
+  namespace: {namespace}
+  labels:
+    app: theia-manager
+spec:
+  replicas: 1
+  selector:
+    matchLabels:
+      app: theia-manager
+  template:
+    metadata:
+      labels:
+        app: theia-manager
+    spec:
+      containers:
+        - name: theia-manager
+          image: {image}
+          args:
+            - --db
+            - /data/flows.npz
+            - --address
+            - 0.0.0.0
+            - --capacity-bytes
+            - "{capacity_bytes}"{tls_args}
+          env:
+            - name: POD_NAMESPACE
+              valueFrom:
+                fieldRef:
+                  fieldPath: metadata.namespace
+            - name: THEIA_TTL_SECONDS
+              value: "{ttl_seconds}"
+          ports:
+            - containerPort: 11347
+              name: api
+          readinessProbe:
+            httpGet:
+              path: /healthz
+              port: 11347
+              scheme: {"HTTPS" if tls else "HTTP"}
+            initialDelaySeconds: 3
+          volumeMounts:
+            - name: data
+              mountPath: /data
+            - name: certs
+              mountPath: /certs
+      volumes:
+        - name: data
+          emptyDir:
+            sizeLimit: {max(capacity_bytes // (1 << 30), 1)}Gi
+        - name: certs
+          emptyDir: {{}}
+""")
+        docs.append(f"""\
+apiVersion: v1
+kind: Service
+metadata:
+  name: theia-manager
+  namespace: {namespace}
+  labels:
+    app: theia-manager
+spec:
+  selector:
+    app: theia-manager
+  ports:
+    - name: api
+      port: 11347
+      targetPort: api
+""")
+        docs.append(f"""\
+apiVersion: v1
+kind: ServiceAccount
+metadata:
+  name: theia-manager
+  namespace: {namespace}
+""")
+    return "---\n".join(docs)
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--namespace", default="flow-visibility")
+    p.add_argument("--no-manager", action="store_true")
+    p.add_argument("--tls", action="store_true")
+    p.add_argument("--capacity-bytes", type=int, default=8 << 30)
+    p.add_argument("--ttl-seconds", type=int, default=12 * 3600)
+    p.add_argument("--image", default="theia-tpu/manager:latest")
+    args = p.parse_args(argv)
+    sys.stdout.write(manifest(
+        args.namespace, not args.no_manager, args.tls,
+        args.capacity_bytes, args.ttl_seconds, args.image))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,97 @@
+"""TLS certificate subsystem: generation, rotation, TLS manager e2e."""
+
+import datetime
+import json
+import ssl
+import urllib.request
+
+from theia_tpu.manager.certs import (
+    apply_server_cert,
+    cert_expiry,
+    generate_self_signed,
+    needs_rotation,
+)
+
+
+def test_generate_self_signed():
+    cert, key = generate_self_signed()
+    assert b"BEGIN CERTIFICATE" in cert
+    assert b"PRIVATE KEY" in key
+    expiry = cert_expiry(cert)
+    now = datetime.datetime.now(datetime.timezone.utc)
+    assert datetime.timedelta(days=360) < expiry - now <= \
+        datetime.timedelta(days=366)
+    assert not needs_rotation(cert)
+
+
+def test_rotation_threshold():
+    cert, _ = generate_self_signed(validity_days=10)
+    assert needs_rotation(cert)  # within the 30-day window
+
+
+def test_apply_server_cert_reuses_and_publishes_ca(tmp_path):
+    d = str(tmp_path / "certs")
+    cert1, key1, ca1 = apply_server_cert(d)
+    cert2, key2, ca2 = apply_server_cert(d)  # valid → reused
+    assert open(cert1, "rb").read() == open(cert2, "rb").read()
+    assert open(ca1, "rb").read() == open(cert1, "rb").read()
+
+
+def test_manager_over_tls(tmp_path):
+    from theia_tpu.manager import TheiaManagerServer
+    from theia_tpu.store import FlowDatabase
+    srv = TheiaManagerServer(FlowDatabase(), port=0,
+                             tls_cert_dir=str(tmp_path / "certs"))
+    srv.start_background()
+    try:
+        ctx = ssl.create_default_context(cafile=srv.ca_cert_path)
+        ctx.check_hostname = True
+        with urllib.request.urlopen(
+                f"https://localhost:{srv.port}/healthz", timeout=10,
+                context=ctx) as r:
+            assert json.loads(r.read()) == {"status": "ok"}
+    finally:
+        srv.shutdown()
+
+
+def test_half_provided_pair_rejected(tmp_path):
+    import pytest
+    with pytest.raises(ValueError, match="together"):
+        apply_server_cert(str(tmp_path), provided_cert="only.crt")
+
+
+def test_provided_ca_published(tmp_path):
+    cert, key = generate_self_signed()
+    cp, kp, cap = (str(tmp_path / n) for n in
+                   ("leaf.crt", "leaf.key", "issuer.crt"))
+    open(cp, "wb").write(cert)
+    open(kp, "wb").write(key)
+    open(cap, "wb").write(b"-----ISSUER CA-----")
+    _, _, published = apply_server_cert(
+        str(tmp_path / "d"), cp, kp, cap)
+    assert open(published, "rb").read() == b"-----ISSUER CA-----"
+
+
+def test_tls_slow_client_does_not_block_server(tmp_path):
+    # A client that connects and sends nothing must not stall other
+    # requests (per-connection handshake on worker threads).
+    import socket
+    import time as _time
+    from theia_tpu.manager import TheiaManagerServer
+    from theia_tpu.store import FlowDatabase
+    srv = TheiaManagerServer(FlowDatabase(), port=0,
+                             tls_cert_dir=str(tmp_path / "certs"))
+    srv.start_background()
+    try:
+        stalker = socket.create_connection(("127.0.0.1", srv.port))
+        _time.sleep(0.2)  # let the server accept it
+        ctx = ssl.create_default_context(cafile=srv.ca_cert_path)
+        t0 = _time.monotonic()
+        with urllib.request.urlopen(
+                f"https://localhost:{srv.port}/healthz", timeout=10,
+                context=ctx) as r:
+            assert json.loads(r.read()) == {"status": "ok"}
+        assert _time.monotonic() - t0 < 5
+        stalker.close()
+    finally:
+        srv.shutdown()

@@ -1,0 +1,127 @@
+"""Dashboard queries + SVG rendering + manager routes."""
+
+import json
+import urllib.request
+
+import pytest
+
+from theia_tpu.dashboards import DASHBOARDS, render
+from theia_tpu.dashboards import queries
+from theia_tpu.data.synth import SynthConfig, generate_flows
+from theia_tpu.store import FlowDatabase
+
+
+@pytest.fixture(scope="module")
+def db():
+    db = FlowDatabase()
+    db.insert_flows(generate_flows(SynthConfig(
+        n_series=32, points_per_series=12, service_fraction=0.3,
+        external_fraction=0.2, protected_fraction=0.4, seed=17)))
+    db.tadetector.insert_rows([{"id": "x", "anomaly": "true"}])
+    db.recommendations.insert_rows([{"id": "r", "kind": "anp",
+                                     "policy": "kind: NetworkPolicy"}])
+    return db
+
+
+def test_homepage_stats(db):
+    stats = queries.homepage(db)
+    assert stats["flowCount"] == 32 * 12
+    assert stats["podCount"] > 0 and stats["namespaceCount"] > 0
+    assert stats["serviceCount"] > 0 and stats["clusterCount"] == 1
+    assert stats["tadAnomalies"] == 1
+    assert stats["recommendations"] == 1
+    assert stats["totalBytes"] > 0
+
+
+def test_flow_records_sorted_and_limited(db):
+    rows = queries.flow_records(db, limit=10)
+    assert len(rows) == 10
+    ends = [r["flowEndSeconds"] for r in rows]
+    assert ends == sorted(ends, reverse=True)
+    assert "sourcePodName" in rows[0]
+
+
+def test_pod_to_pod_links_and_series(db):
+    data = queries.pod_to_pod(db, k=5)
+    assert 0 < len(data["links"]) <= 5
+    for link in data["links"]:
+        assert link["source"].startswith("pod-")
+        assert link["target"].startswith("pod-")
+        assert link["value"] > 0
+    ts = data["throughput"]
+    assert ts["times"] and ts["series"]
+
+
+def test_pod_to_service_and_external(db):
+    svc = queries.pod_to_service(db, k=5)
+    assert all("/svc-" in l["target"] for l in svc["links"])
+    ext = queries.pod_to_external(db, k=5)
+    assert all(l["target"].startswith("203.0.113.")
+               for l in ext["links"])
+
+
+def test_node_to_node(db):
+    data = queries.node_to_node(db, k=5)
+    assert all(l["source"].startswith("node-") for l in data["links"])
+
+
+def test_networkpolicy_chord(db):
+    data = queries.networkpolicy(db, k=5)
+    assert data["chord"], "protected flows should produce policy links"
+    actions = {d["name"] for d in data["byAction"]}
+    assert "allow" in actions or "none" in actions
+
+
+def test_network_topology_edges(db):
+    data = queries.network_topology(db)
+    targets = {e["target"] for e in data["edges"]}
+    assert "external" in targets
+    assert any(t.startswith("ns-") for t in targets)
+
+
+@pytest.mark.parametrize("name", list(DASHBOARDS))
+def test_render_all_dashboards(db, name):
+    page = render(name, db)
+    assert page.startswith("<!doctype html>")
+    assert "theia-tpu" in page
+    if name not in ("homepage", "flow_records"):
+        assert "<svg" in page
+
+
+def test_manager_serves_dashboards(db):
+    from theia_tpu.manager import TheiaManagerServer
+    srv = TheiaManagerServer(db, port=0)
+    srv.start_background()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/dashboards/pod_to_pod",
+                timeout=10) as r:
+            body = r.read().decode()
+        assert "<svg" in body and r.headers["Content-Type"].startswith(
+            "text/html")
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/dashboards/api/homepage",
+                timeout=10) as r:
+            doc = json.loads(r.read())
+        assert doc["data"]["flowCount"] == 32 * 12
+    finally:
+        srv.shutdown()
+
+
+def test_dashboard_api_time_window_params(db):
+    # start/end/limit reach the query functions through the REST layer
+    from theia_tpu.manager import TheiaManagerServer
+    srv = TheiaManagerServer(db, port=0)
+    srv.start_background()
+    try:
+        flows = db.flows.scan()
+        t0 = int(flows["flowEndSeconds"].min())
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/dashboards/api/"
+                f"flow_records?limit=3&end={t0 + 5}", timeout=10) as r:
+            doc = json.loads(r.read())
+        rows = doc["data"]
+        assert len(rows) == 3
+        assert all(r["flowEndSeconds"] < t0 + 5 for r in rows)
+    finally:
+        srv.shutdown()

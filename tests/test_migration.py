@@ -1,0 +1,90 @@
+"""Schema migration: up/down, version stamping, load-time upgrade."""
+
+import numpy as np
+import pytest
+
+from theia_tpu.data.synth import SynthConfig, generate_flows
+from theia_tpu.store import FlowDatabase
+from theia_tpu.store.migration import (
+    CURRENT_SCHEMA_VERSION,
+    VERSION_KEY,
+    force,
+    migrate,
+    payload_version,
+    schema_version_for,
+)
+
+
+def _payload_from_db(db):
+    import io
+    buf = io.BytesIO()
+    db.save_to = None
+    import tempfile, os
+    with tempfile.TemporaryDirectory() as td:
+        p = os.path.join(td, "db.npz")
+        db.save(p)
+        with np.load(p, allow_pickle=True) as z:
+            return {k: z[k] for k in z.files}
+
+
+def test_save_stamps_current_version(tmp_path):
+    db = FlowDatabase()
+    db.insert_flows(generate_flows(SynthConfig(n_series=2,
+                                               points_per_series=3)))
+    p = str(tmp_path / "db.npz")
+    db.save(p)
+    with np.load(p, allow_pickle=True) as z:
+        assert int(z[VERSION_KEY]) == CURRENT_SCHEMA_VERSION
+
+
+def test_down_and_up_roundtrip():
+    db = FlowDatabase()
+    db.insert_flows(generate_flows(SynthConfig(n_series=2,
+                                               points_per_series=3)))
+    payload = _payload_from_db(db)
+    migrate(payload, target=1)
+    assert payload_version(payload) == 1
+    assert "flows/trusted" not in payload
+    assert "flows/egressName" not in payload
+    migrate(payload, target=CURRENT_SCHEMA_VERSION)
+    assert "flows/trusted" in payload and "flows/egressName" in payload
+    n = len(payload["flows/timeInserted"])
+    assert (payload["flows/trusted"] == 0).all()
+    assert len(payload["flows/egressName"]) == n
+
+
+def test_load_migrates_old_file(tmp_path):
+    db = FlowDatabase()
+    batch = generate_flows(SynthConfig(n_series=3, points_per_series=4))
+    db.insert_flows(batch)
+    payload = _payload_from_db(db)
+    migrate(payload, target=1)   # simulate a v1-era file
+    old = str(tmp_path / "old.npz")
+    np.savez_compressed(old, **payload)
+
+    db2 = FlowDatabase.load(old)
+    assert len(db2.flows) == len(batch)
+    scanned = db2.flows.scan()
+    assert (scanned["trusted"] == 0).all()
+    assert all(s == "" for s in scanned.strings("egressName"))
+    np.testing.assert_array_equal(scanned.strings("sourceIP"),
+                                  batch.strings("sourceIP"))
+
+
+def test_refuses_future_version():
+    payload = {}
+    force(payload, 99)
+    with pytest.raises(ValueError, match="newer schema"):
+        migrate(payload)
+
+
+def test_unstamped_payload_version_inferred():
+    assert payload_version({"flows/egressName": np.zeros(0)}) == 3
+    assert payload_version({"flows/trusted": np.zeros(0)}) == 2
+    assert payload_version({"flows/timeInserted": np.zeros(0)}) == 1
+
+
+def test_framework_version_map():
+    assert schema_version_for("0.1.0") == 1
+    assert schema_version_for("0.2.0") == 3
+    assert schema_version_for("9.9.9") == CURRENT_SCHEMA_VERSION

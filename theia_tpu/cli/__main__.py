@@ -45,6 +45,16 @@ class APIError(SystemExit):
     pass
 
 
+_CA_CERT = ""
+
+
+def _url_context():
+    if not _CA_CERT:
+        return None
+    import ssl
+    return ssl.create_default_context(cafile=_CA_CERT)
+
+
 def _request(addr: str, method: str, path: str,
              body: Optional[Dict] = None) -> Dict:
     req = urllib.request.Request(
@@ -52,7 +62,8 @@ def _request(addr: str, method: str, path: str,
         data=json.dumps(body).encode() if body is not None else None,
         headers={"Content-Type": "application/json"})
     try:
-        with urllib.request.urlopen(req, timeout=30) as resp:
+        with urllib.request.urlopen(req, timeout=30,
+                                    context=_url_context()) as resp:
             raw = resp.read()
     except urllib.error.HTTPError as e:
         detail = e.read().decode(errors="replace")
@@ -295,7 +306,8 @@ def supportbundle(args) -> None:
         raise APIError("error: support bundle collection timed out")
     req = urllib.request.Request(
         args.manager_addr + path + "/theia-manager/download")
-    with urllib.request.urlopen(req, timeout=60) as resp:
+    with urllib.request.urlopen(req, timeout=60,
+                                context=_url_context()) as resp:
         data = resp.read()
     out = args.file or "theia-supportbundle.tar.gz"
     with open(out, "wb") as f:
@@ -320,6 +332,9 @@ def build_parser() -> argparse.ArgumentParser:
         prog="theia", description="theia-tpu command line tool")
     p.add_argument("--manager-addr", default=DEFAULT_ADDR,
                    help="theia-manager API address")
+    p.add_argument("--ca-cert", default="",
+                   help="CA certificate for a TLS manager (the "
+                        "published theia-ca.crt)")
     sub = p.add_subparsers(dest="command", required=True)
 
     def add_job_commands(group, run_fn, status_fn, retrieve_fn, list_fn,
@@ -410,7 +425,9 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> None:
+    global _CA_CERT
     args = build_parser().parse_args(argv)
+    _CA_CERT = getattr(args, "ca_cert", "") or ""
     try:
         args.fn(args)
     except BrokenPipeError:

@@ -1,0 +1,6 @@
+"""Flow-visibility dashboards: store-native queries + SVG web UI."""
+
+from .queries import DASHBOARDS
+from .web import render
+
+__all__ = ["DASHBOARDS", "render"]

@@ -2,39 +2,59 @@
 
 Usage:
   python -m theia_tpu.manager [--db flows.npz] [--port 11347]
-      [--capacity-bytes N] [--synth N_SERIES]
+      [--address 0.0.0.0] [--capacity-bytes N] [--ttl-seconds N]
+      [--synth N_SERIES] [--tls-cert-dir DIR [--tls-cert F --tls-key F
+      [--tls-ca F]]]
 
 --synth seeds the store with synthetic flows (demo/e2e); --db loads a
-persisted FlowDatabase (and persists results back on shutdown).
+persisted FlowDatabase (and persists results back on shutdown). TTL can
+also come from the THEIA_TTL_SECONDS env var (the deployment manifest
+sets it; flag wins).
 """
 
 from __future__ import annotations
 
 import argparse
+import os
 import signal
 import sys
+import threading
 
 
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(prog="theia_tpu.manager")
     p.add_argument("--db", default=None, help="FlowDatabase .npz path")
     p.add_argument("--port", type=int, default=None)
+    p.add_argument("--address", default="127.0.0.1",
+                   help="bind address (0.0.0.0 inside a pod)")
     p.add_argument("--capacity-bytes", type=int, default=8 << 30)
+    p.add_argument("--ttl-seconds", type=int, default=None,
+                   help="flow TTL; default THEIA_TTL_SECONDS env or off")
     p.add_argument("--workers", type=int, default=2)
     p.add_argument("--synth", type=int, default=0,
                    help="seed the store with N synthetic series")
+    p.add_argument("--tls-cert-dir", default=None,
+                   help="enable TLS; certs generated/loaded here")
+    p.add_argument("--tls-cert", default=None)
+    p.add_argument("--tls-key", default=None)
+    p.add_argument("--tls-ca", default=None,
+                   help="issuing CA bundle to publish for provided certs")
     args = p.parse_args(argv)
 
     from ..store import FlowDatabase
     from .api import API_PORT, TheiaManagerServer
 
+    ttl = args.ttl_seconds
+    if ttl is None and os.environ.get("THEIA_TTL_SECONDS"):
+        ttl = int(os.environ["THEIA_TTL_SECONDS"])
+
     if args.db:
         try:
-            db = FlowDatabase.load(args.db)
+            db = FlowDatabase.load(args.db, ttl_seconds=ttl)
         except FileNotFoundError:
-            db = FlowDatabase()
+            db = FlowDatabase(ttl_seconds=ttl)
     else:
-        db = FlowDatabase()
+        db = FlowDatabase(ttl_seconds=ttl)
     if args.synth:
         from ..data.synth import SynthConfig, generate_flows
         db.insert_flows(generate_flows(SynthConfig(
@@ -43,19 +63,30 @@ def main(argv=None) -> None:
 
     server = TheiaManagerServer(
         db, port=args.port if args.port is not None else API_PORT,
-        workers=args.workers, capacity_bytes=args.capacity_bytes)
-    print(f"theia-manager listening on :{server.port}", file=sys.stderr)
+        workers=args.workers, capacity_bytes=args.capacity_bytes,
+        address=args.address,
+        tls_cert_dir=args.tls_cert_dir, tls_cert=args.tls_cert,
+        tls_key=args.tls_key, tls_ca=args.tls_ca)
+    if server.ca_cert_path:
+        print(f"CA certificate published at {server.ca_cert_path}",
+              file=sys.stderr)
+    print(f"theia-manager listening on {args.address}:{server.port}",
+          file=sys.stderr)
 
     def stop(*_):
-        # shutdown() must not run on the thread executing
-        # serve_forever() (BaseServer.shutdown would deadlock); hand it
-        # to a helper thread and let serve_forever return below.
-        import threading
-        threading.Thread(target=server.shutdown, daemon=True).start()
+        # Only unblock serve_forever here; shutdown() would deadlock on
+        # this thread (it IS the serve_forever thread) and the ordered
+        # teardown below must finish before the db is persisted.
+        threading.Thread(target=server.httpd.shutdown,
+                         daemon=True).start()
 
     signal.signal(signal.SIGINT, stop)
     signal.signal(signal.SIGTERM, stop)
     server.serve_forever()
+    # Drain in-flight jobs before persisting so their result rows make
+    # it into the saved file.
+    server.controller.wait_all(timeout=60)
+    server.shutdown()
     if args.db:
         db.save(args.db)
 

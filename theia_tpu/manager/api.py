@@ -224,7 +224,38 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
         if self.path.startswith(GROUP_SYSTEM):
             self._get_system(parts)
             return
+        if parts and parts[0] == "dashboards":
+            self._get_dashboard(parts)
+            return
         raise KeyError(self.path)
+
+    def _get_dashboard(self, parts) -> None:
+        """/dashboards/[<name>] → HTML page;
+        /dashboards/api/<name>[?start=..&end=..&limit=..&k=..] → the
+        underlying JSON data (the Grafana-datasource equivalent of the
+        reference's read path; start/end play the $__timeFilter role)."""
+        import inspect
+        import urllib.parse
+
+        from ..dashboards import DASHBOARDS, render
+        if len(parts) >= 3 and parts[1] == "api":
+            fn = DASHBOARDS[parts[2]]
+            qs = urllib.parse.parse_qs(
+                urllib.parse.urlsplit(self.path).query)
+            accepted = inspect.signature(fn).parameters
+            kwargs = {name: int(qs[name][0]) for name
+                      in ("start", "end", "limit", "k")
+                      if name in qs and name in accepted}
+            self._send_json({"dashboard": parts[2],
+                             "data": fn(self.controller.db, **kwargs)})
+            return
+        name = parts[1] if len(parts) > 1 else "homepage"
+        page = render(name, self.controller.db).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/html; charset=utf-8")
+        self.send_header("Content-Length", str(len(page)))
+        self.end_headers()
+        self.wfile.write(page)
 
     def _get_intelligence(self, parts) -> None:
         resource = parts[3]
@@ -316,12 +347,34 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
         raise KeyError(self.path)
 
 
+class _TLSCapableServer(ThreadingHTTPServer):
+    """HTTP server that performs the TLS handshake per connection on
+    the worker thread — wrapping the *listening* socket would run the
+    handshake inside accept() on the serve_forever thread, letting one
+    silent client stall the entire API."""
+
+    ssl_context = None
+    handshake_timeout = 10.0
+
+    def finish_request(self, request, client_address):
+        if self.ssl_context is not None:
+            request.settimeout(self.handshake_timeout)
+            request = self.ssl_context.wrap_socket(request,
+                                                   server_side=True)
+            request.settimeout(None)
+        super().finish_request(request, client_address)
+
+
 class TheiaManagerServer:
     """Wires controller + stats + bundles into one HTTP server."""
 
     def __init__(self, db, port: int = API_PORT, workers: int = 2,
                  capacity_bytes: int = 8 << 30,
-                 address: str = "127.0.0.1") -> None:
+                 address: str = "127.0.0.1",
+                 tls_cert_dir: Optional[str] = None,
+                 tls_cert: Optional[str] = None,
+                 tls_key: Optional[str] = None,
+                 tls_ca: Optional[str] = None) -> None:
         self.controller = JobController(db, workers=workers)
         self.stats = StatsProvider(db, capacity_bytes=capacity_bytes)
         self.bundles = SupportBundleManager(self.controller, self.stats)
@@ -331,7 +384,21 @@ class TheiaManagerServer:
             "stats": self.stats,
             "bundles": self.bundles,
         })
-        self.httpd = ThreadingHTTPServer((address, port), handler)
+        self.httpd = _TLSCapableServer((address, port), handler)
+        self.ca_cert_path: Optional[str] = None
+        if tls_cert_dir is not None:
+            # Self-signed (or provided) serving cert, reference
+            # certificate.ApplyServerCert (manager/certs.py).
+            import ssl
+
+            from .certs import apply_server_cert
+            cert, key, ca = apply_server_cert(
+                tls_cert_dir, tls_cert, tls_key, tls_ca)
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+            ctx.load_cert_chain(cert, key)
+            self.httpd.ssl_context = ctx
+            self.ca_cert_path = ca
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
         self._serving = False

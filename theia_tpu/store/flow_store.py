@@ -296,7 +296,9 @@ class FlowDatabase:
     # -- persistence -------------------------------------------------------
 
     def save(self, path: str) -> None:
-        """Persist all tables to one .npz (columns + dictionary tables)."""
+        """Persist all tables to one .npz (columns + dictionary tables),
+        stamped with the current schema version (store/migration.py)."""
+        from .migration import CURRENT_SCHEMA_VERSION, force
         payload: Dict[str, np.ndarray] = {}
         for table in (self.flows, self.tadetector, self.recommendations):
             data = table.scan()
@@ -305,29 +307,39 @@ class FlowDatabase:
             for name, d in table.dicts.items():
                 payload[f"{table.name}/__dict__/{name}"] = np.asarray(
                     d._strings, dtype=object)
+        force(payload, CURRENT_SCHEMA_VERSION)
         np.savez_compressed(path, **payload)
 
     @classmethod
     def load(cls, path: str,
              ttl_seconds: Optional[int] = None) -> "FlowDatabase":
+        """Load a persisted database, migrating older schema versions
+        up to current first (the reference's schema-management init
+        container runs before the server the same way)."""
+        from .migration import migrate
         db = cls(ttl_seconds=None)
         with np.load(path, allow_pickle=True) as z:
-            for table in (db.flows, db.tadetector, db.recommendations):
-                cols: Dict[str, np.ndarray] = {}
-                for name, d in table.dicts.items():
-                    key = f"{table.name}/__dict__/{name}"
-                    if key in z:
-                        for s in z[key]:
-                            d.encode_one(str(s))
-                for col in table.schema:
-                    key = f"{table.name}/{col.name}"
-                    if key in z:
-                        cols[col.name] = z[key]
-                if cols and len(next(iter(cols.values()))):
-                    batch = ColumnarBatch(cols, table.dicts)
-                    if table is db.flows:
-                        db.insert_flows(batch)
-                    else:
-                        table.insert(batch)
+            payload = {k: z[k] for k in z.files}
+        migrate(payload)
+        for table in (db.flows, db.tadetector, db.recommendations):
+            cols: Dict[str, np.ndarray] = {}
+            for name, d in table.dicts.items():
+                key = f"{table.name}/__dict__/{name}"
+                if key in payload:
+                    for s in payload[key]:
+                        d.encode_one(str(s))
+            for col in table.schema:
+                key = f"{table.name}/{col.name}"
+                if key in payload:
+                    cols[col.name] = payload[key]
+            if cols and len(next(iter(cols.values()))):
+                batch = ColumnarBatch(
+                    {c.name: cols.get(c.name, np.zeros(
+                        len(next(iter(cols.values()))), c.host_dtype))
+                     for c in table.schema}, table.dicts)
+                if table is db.flows:
+                    db.insert_flows(batch)
+                else:
+                    table.insert(batch)
         db.ttl_seconds = ttl_seconds
         return db

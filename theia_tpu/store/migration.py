@@ -1,0 +1,124 @@
+"""Versioned schema migration for persisted FlowDatabase files.
+
+Re-provides the reference's schema-management init container
+(plugins/clickhouse-schema-management/main.go:62-117): a framework
+version maps to a schema version (VERSION_MAP), stored data is migrated
+up or down through ordered migrators to the target, and the resulting
+version is stamped so future loads know where they stand. The reference
+keeps five SQL migrators
+(build/charts/theia/provisioning/datasources/migrators/0000{1..5}_*.sql);
+here migrators are column transforms over the persisted .npz payload.
+
+Schema history (mirrors the reference's column evolution):
+  v1 — flows without `trusted`           (pre policy-feedback)
+  v2 — + `trusted` UInt8                 (subsequent-NPR support)
+  v3 — + `egressName`, `egressIP`        (egress observability; current)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List
+
+import numpy as np
+
+CURRENT_SCHEMA_VERSION = 3
+VERSION_KEY = "__schema_version__"
+
+# framework version → schema version (reference VERSION_MAP,
+# clickhouse-schema-management/main.go)
+VERSION_MAP = {
+    "0.1.0": 1,
+    "0.1.1": 2,
+    "0.2.0": 3,
+}
+
+Payload = Dict[str, np.ndarray]
+
+
+def _n_rows(payload: Payload) -> int:
+    for key, arr in payload.items():
+        if key.startswith("flows/") and "__dict__" not in key:
+            return len(arr)
+    return 0
+
+
+def _add_numeric(payload: Payload, name: str, dtype) -> None:
+    payload[f"flows/{name}"] = np.zeros(_n_rows(payload), dtype)
+
+
+def _add_string(payload: Payload, name: str) -> None:
+    # code 0 == '' for every row; dictionary starts with just ''
+    payload[f"flows/{name}"] = np.zeros(_n_rows(payload), np.int32)
+    payload[f"flows/__dict__/{name}"] = np.asarray([""], dtype=object)
+
+
+def _drop(payload: Payload, name: str) -> None:
+    payload.pop(f"flows/{name}", None)
+    payload.pop(f"flows/__dict__/{name}", None)
+
+
+@dataclasses.dataclass(frozen=True)
+class Migration:
+    version: int            # version this migration upgrades TO
+    name: str
+    up: Callable[[Payload], None]
+    down: Callable[[Payload], None]   # reverts to version-1
+
+
+MIGRATIONS: List[Migration] = [
+    Migration(
+        version=2, name="add_trusted",
+        up=lambda p: _add_numeric(p, "trusted", np.int32),
+        down=lambda p: _drop(p, "trusted")),
+    Migration(
+        version=3, name="add_egress_name_ip",
+        up=lambda p: (_add_string(p, "egressName"),
+                      _add_string(p, "egressIP")) and None,
+        down=lambda p: (_drop(p, "egressName"),
+                        _drop(p, "egressIP")) and None),
+]
+
+
+def payload_version(payload: Payload) -> int:
+    if VERSION_KEY in payload:
+        return int(np.asarray(payload[VERSION_KEY]).item())
+    # Unstamped files predate the migrator; infer from columns.
+    if "flows/egressName" in payload:
+        return 3
+    if "flows/trusted" in payload:
+        return 2
+    return 1
+
+
+def migrate(payload: Payload,
+            target: int = CURRENT_SCHEMA_VERSION) -> Payload:
+    """Migrate a persisted payload to `target`, stamping the result.
+    Runs up- or down-migrators in order (main.go startMigration)."""
+    if not 1 <= target <= CURRENT_SCHEMA_VERSION:
+        raise ValueError(f"unknown schema version {target}")
+    version = payload_version(payload)
+    if version > CURRENT_SCHEMA_VERSION:
+        raise ValueError(
+            f"data written by a newer schema (v{version}); refusing")
+    while version < target:
+        step = next(m for m in MIGRATIONS if m.version == version + 1)
+        step.up(payload)
+        version += 1
+    while version > target:
+        step = next(m for m in MIGRATIONS if m.version == version)
+        step.down(payload)
+        version -= 1
+    force(payload, version)
+    return payload
+
+
+def force(payload: Payload, version: int) -> None:
+    """Stamp a version without running migrators (main.go Force())."""
+    payload[VERSION_KEY] = np.asarray(version, np.int64)
+
+
+def schema_version_for(framework_version: str) -> int:
+    """Map a framework version to its schema version; unknown versions
+    get the current schema (forward-compatible default)."""
+    return VERSION_MAP.get(framework_version, CURRENT_SCHEMA_VERSION)
