@@ -85,17 +85,27 @@ def main() -> None:
     # Secondary diagnostics (stderr): native ingest rate + streaming
     # alert latency on this chip.
     try:
-        from theia_tpu.ingest import TsvDecoder, encode_tsv, \
-            native_available
+        from theia_tpu.ingest import BlockEncoder, TsvDecoder, \
+            encode_tsv, native_available
         if native_available():
             payload = encode_tsv(batch) * 8
             dec = TsvDecoder()
-            dec.decode(payload[:20000])
+            dec.decode(payload)   # warm
             t7 = time.perf_counter()
             decoded = dec.decode(payload)
             t8 = time.perf_counter()
-            print(f"native ingest: {len(decoded) / (t8 - t7):,.0f} "
-                  f"rows/s", file=sys.stderr)
+            print(f"native ingest (TSV): "
+                  f"{len(decoded) / (t8 - t7):,.0f} rows/s",
+                  file=sys.stderr)
+            enc = BlockEncoder(dicts=batch.dicts)
+            blocks = [enc.encode(batch) for _ in range(9)]
+            bdec = TsvDecoder()
+            bdec.decode_block(blocks[0])   # warm + dict delta
+            t7 = time.perf_counter()
+            n_blk = sum(len(bdec.decode_block(p)) for p in blocks[1:])
+            t8 = time.perf_counter()
+            print(f"native ingest (binary block): "
+                  f"{n_blk / (t8 - t7):,.0f} rows/s", file=sys.stderr)
     except Exception as e:
         print(f"ingest bench skipped: {e}", file=sys.stderr)
 

@@ -1,5 +1,12 @@
 """Ingest paths: wire bytes -> columnar blocks (native C++ + fallback)."""
 
-from .native import TsvDecoder, encode_tsv, native_available
+from .native import (
+    BLOCK_MAGIC,
+    BlockEncoder,
+    TsvDecoder,
+    encode_tsv,
+    native_available,
+)
 
-__all__ = ["TsvDecoder", "encode_tsv", "native_available"]
+__all__ = ["BLOCK_MAGIC", "BlockEncoder", "TsvDecoder", "encode_tsv",
+           "native_available"]

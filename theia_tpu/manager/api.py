@@ -181,6 +181,8 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
             self._get()
         except KeyError:
             self._send_error_json(404, f"not found: {self.path}")
+        except ValueError as e:  # malformed query params are the
+            self._send_error_json(400, str(e))       # client's fault
         except Exception as e:  # surface handler bugs as 500s
             self._send_error_json(500, f"{type(e).__name__}: {e}")
 
