@@ -222,11 +222,19 @@ int64_t fb_decode_block(void* h, const char* buf, int64_t nbytes,
     memcpy(&count, p, 4); p += 4;
     if (count < 0) return -1;
     if (base != static_cast<int32_t>(dict.strings.size())) return -2;
+    // Delta entries must be novel (not already in the dictionary, and
+    // not repeated within the delta) — a duplicate would grow
+    // `strings` without a matching to_code entry and desync the code
+    // sequence for good.
+    std::unordered_map<std::string_view, int32_t> fresh;
     for (int32_t i = 0; i < count; ++i) {
       int32_t len;
       if (!need(4)) return -1;
       memcpy(&len, p, 4); p += 4;
       if (len < 0 || !need(len)) return -1;
+      std::string_view sv(p, static_cast<size_t>(len));
+      if (dict.to_code.find(sv) != dict.to_code.end()) return -2;
+      if (!fresh.emplace(sv, i).second) return -2;
       p += len;
     }
     new_sizes[d->slot[c]] = base + count;

@@ -150,3 +150,30 @@ def test_kernels_all_padding_safe(rng, algo):
     r1 = fn(x1, m)[2]
     r2 = fn(x2, m)[2]
     np.testing.assert_array_equal(np.asarray(r1), np.asarray(r2))
+
+
+def test_dbscan_pallas_kernel_matches_xla(rng):
+    # The Pallas kernel (interpret mode on CPU; Mosaic on real TPU) must
+    # be bit-identical to the XLA formulation across shapes/padding.
+    from theia_tpu.ops.dbscan_pallas import dbscan_noise_pallas
+    for s, t in [(5, 7), (16, 128), (33, 40), (1, 1)]:
+        x = rng.uniform(1e5, 1e9, size=(s, t)).astype(np.float32)
+        x[:, :max(t // 2, 1)] = rng.normal(
+            2e8, 1e7, size=(s, max(t // 2, 1)))
+        m = rng.random(size=(s, t)) > 0.2
+        ref = np.asarray(dbscan_noise(x, m))
+        pal = np.asarray(dbscan_noise_pallas(x, m, interpret=True))
+        np.testing.assert_array_equal(ref, pal, err_msg=f"{s}x{t}")
+
+
+def test_dbscan_scores_pallas_toggle(rng):
+    # use_pallas=True must produce the same scores as the XLA branch
+    # (off-TPU the kernel runs in interpreter mode automatically).
+    from theia_tpu.ops.dbscan import dbscan_scores
+    x = rng.uniform(1e5, 1e9, size=(4, 16)).astype(np.float32)
+    m = np.ones((4, 16), bool)
+    calc_x, std_x, anom_x = dbscan_scores(x, m, use_pallas=False)
+    calc_p, std_p, anom_p = dbscan_scores(x, m, use_pallas=True)
+    np.testing.assert_array_equal(np.asarray(anom_x),
+                                  np.asarray(anom_p))
+    np.testing.assert_allclose(np.asarray(std_x), np.asarray(std_p))

@@ -169,8 +169,7 @@ class TsvDecoder:
                 continue
             d = self.dicts[col.name]
             start = self._synced_len[i]
-            with d._lock:
-                pending = list(d._strings[start:])
+            pending = d.entries_since(start)
             for s in pending:
                 raw = s.encode()
                 self._lib.fb_seed(self._handle, i, raw, len(raw))
@@ -293,6 +292,7 @@ class TsvDecoder:
                     "match the decoder's dictionary (blocks must be "
                     "decoded in stream order)")
             entries = []
+            seen = set()
             for _ in range(int(count)):
                 if off + 4 > len(payload):
                     raise ValueError(
@@ -302,8 +302,16 @@ class TsvDecoder:
                 if ln < 0 or off + ln > len(payload):
                     raise ValueError(
                         "malformed flow block (truncated)")
-                entries.append(payload[off:off + ln].decode())
+                s = payload[off:off + ln].decode()
                 off += ln
+                # novelty: a duplicate (of an existing entry or within
+                # the delta) would desync the append-only code sequence
+                if d.lookup(s) is not None or s in seen:
+                    raise ValueError(
+                        f"dictionary desync on {col.name}: delta "
+                        f"repeats entry {s!r}")
+                seen.add(s)
+                entries.append(s)
             deltas[col.name] = entries
             limits[col.name] = int(base) + len(entries)
         cols: Dict[str, np.ndarray] = {}
@@ -426,8 +434,7 @@ class BlockEncoder:
                 code_cols[col.name] = d.encode(
                     list(batch.strings(col.name))).astype(np.int32)
             base = self._sent[col.name]
-            with d._lock:
-                delta = list(d._strings[base:])
+            delta = d.entries_since(base)
             parts.append(np.asarray([base, len(delta)],
                                     np.int32).tobytes())
             for s in delta:

@@ -44,15 +44,58 @@ def dbscan_noise(x: jnp.ndarray, mask: jnp.ndarray,
     return mask & ~core & ~reachable
 
 
+@functools.lru_cache(maxsize=1)
+def _pallas_usable() -> bool:
+    """One-time probe: can the Pallas kernel compile+run on the default
+    backend? (True on real TPU; False where Mosaic isn't available —
+    the XLA formulation is used there.) Overridable with
+    THEIA_TPU_PALLAS=1/0."""
+    import os
+
+    flag = os.environ.get("THEIA_TPU_PALLAS", "auto").lower()
+    if flag in ("0", "off", "false"):
+        return False
+    force = flag in ("1", "on", "true")
+    if not force and jax.default_backend() not in ("tpu", "axon"):
+        return False
+    try:
+        from .dbscan_pallas import dbscan_noise_pallas
+
+        probe = dbscan_noise_pallas(
+            jnp.zeros((2, 4), jnp.float32), jnp.ones((2, 4), bool))
+        jax.block_until_ready(probe)
+        return True
+    except Exception:
+        if force:
+            raise
+        return False
+
+
 def dbscan_scores(x: jnp.ndarray, mask: jnp.ndarray,
                   eps: float = DEFAULT_EPS,
-                  min_samples: int = DEFAULT_MIN_SAMPLES):
+                  min_samples: int = DEFAULT_MIN_SAMPLES,
+                  use_pallas: bool | None = None):
     """(algoCalc placeholder zeros, stddev, anomaly) for DBSCAN.
 
     stddev is still emitted to fill the tadetector row shape (the
     reference computes it in the groupby regardless of algorithm).
+
+    use_pallas=None auto-selects: the tiled Pallas kernel on TPU (no
+    [S,T,T] HBM round-trip), the fused XLA formulation elsewhere.
     """
-    anomaly = dbscan_noise(x, mask, eps=eps, min_samples=min_samples)
+    if use_pallas is None:
+        use_pallas = _pallas_usable()
+    if use_pallas:
+        from .dbscan_pallas import dbscan_noise_pallas
+
+        # Off-TPU, an explicit use_pallas=True runs the kernel in
+        # interpreter mode (same code path, testable on the CPU mesh).
+        anomaly = dbscan_noise_pallas(
+            x, mask, eps=eps, min_samples=min_samples,
+            interpret=jax.default_backend() not in ("tpu", "axon"))
+    else:
+        anomaly = dbscan_noise(x, mask, eps=eps,
+                               min_samples=min_samples)
     calc = jnp.zeros_like(x)
     std = masked_stddev_samp(x, mask)
     return calc, std, anomaly

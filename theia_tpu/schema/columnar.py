@@ -76,6 +76,12 @@ class StringDictionary:
         """Code for `s` if present, else None (never allocates)."""
         return self._to_code.get(s)
 
+    def entries_since(self, start: int) -> List[str]:
+        """Snapshot of entries [start:), in code order — for replaying
+        deltas into a peer dictionary (native decoder, wire blocks)."""
+        with self._lock:
+            return list(self._strings[start:])
+
     def copy(self) -> "StringDictionary":
         """Independent copy (same codes for existing strings)."""
         out = StringDictionary()
