@@ -187,10 +187,12 @@ int64_t fb_decode(void* h, const char* buf, int64_t nbytes,
 //   per column (schema order): raw plane —
 //       numeric: n_rows x 8 bytes (int64 / f64 through the int plane)
 //       string:  n_rows x 4 bytes (int32 codes)
-// Error codes: -1 malformed, -2 dictionary desync, -3 outputs too
-// small, -4 string code out of dictionary range. The block is fully
-// validated BEFORE any dictionary mutation or output write, so a bad
-// block leaves the decoder exactly as it was (no poisoned state).
+// Error codes: -1 malformed, -2 dictionary desync (delta base !=
+// dictionary size), -3 outputs too small, -4 string code out of
+// dictionary range, -5 delta repeats an existing or intra-delta entry.
+// The block is fully validated BEFORE any dictionary mutation or
+// output write, so a bad block leaves the decoder exactly as it was
+// (no poisoned state).
 int64_t fb_decode_block(void* h, const char* buf, int64_t nbytes,
                         int64_t max_rows, int64_t* out_ints,
                         int32_t* out_codes) {
@@ -233,8 +235,8 @@ int64_t fb_decode_block(void* h, const char* buf, int64_t nbytes,
       memcpy(&len, p, 4); p += 4;
       if (len < 0 || !need(len)) return -1;
       std::string_view sv(p, static_cast<size_t>(len));
-      if (dict.to_code.find(sv) != dict.to_code.end()) return -2;
-      if (!fresh.emplace(sv, i).second) return -2;
+      if (dict.to_code.find(sv) != dict.to_code.end()) return -5;
+      if (!fresh.emplace(sv, i).second) return -5;
       p += len;
     }
     new_sizes[d->slot[c]] = base + count;

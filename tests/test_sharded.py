@@ -1,0 +1,186 @@
+"""Sharded (Distributed-table) store + multicluster semantics.
+
+Mirrors the reference's scale-out contracts: rand() row sharding over N
+shards (create_table.sh:387-403), SummingMergeTree view merges across
+shards, cluster-wide retention (clickhouse-monitor), and the
+multicluster e2e (test/e2e_mc/multicluster_test.go:37-80 — two clusters
+write distinct clusterUUIDs into one store).
+"""
+
+import numpy as np
+import pytest
+
+from theia_tpu.analytics import TadQuerySpec, run_tad
+from theia_tpu.data.synth import SynthConfig, generate_flows
+from theia_tpu.store import FlowDatabase, ShardedFlowDatabase
+
+
+@pytest.fixture()
+def batch():
+    return generate_flows(SynthConfig(
+        n_series=24, points_per_series=12, anomaly_fraction=0.25,
+        base_throughput=2e7, anomaly_magnitude=40.0, seed=11))
+
+
+def _row_keys(data):
+    """Order-independent row identity for comparisons."""
+    return sorted(zip(data.strings("sourceIP").tolist(),
+                      np.asarray(data["flowEndSeconds"]).tolist(),
+                      np.asarray(data["throughput"]).tolist()))
+
+
+def test_rows_are_routed_and_conserved(batch):
+    db = ShardedFlowDatabase(n_shards=3, seed=1)
+    assert db.insert_flows(batch) == len(batch)
+    assert len(db.flows) == len(batch)
+    # with 288 rows over 3 shards, every shard must get some
+    per_shard = [len(s.flows) for s in db.shards]
+    assert all(n > 0 for n in per_shard)
+    assert sum(per_shard) == len(batch)
+    # the distributed scan returns exactly the inserted rows
+    assert _row_keys(db.flows.scan()) == _row_keys(batch)
+
+
+def test_sharded_tad_matches_single_node(batch):
+    single = FlowDatabase()
+    single.insert_flows(batch)
+    sharded = ShardedFlowDatabase(n_shards=4, seed=2)
+    sharded.insert_flows(batch)
+    run_tad(single, "EWMA", TadQuerySpec(), tad_id="a" * 32)
+    run_tad(sharded, "EWMA", TadQuerySpec(), tad_id="b" * 32)
+    s_rows = single.tadetector.scan()
+    d_rows = sharded.tadetector.scan()
+    key = lambda d: sorted(zip(  # noqa: E731
+        d.strings("sourceIP").tolist(),
+        np.asarray(d["flowEndSeconds"]).tolist(),
+        np.asarray(d["throughput"]).tolist(),
+        d.strings("anomaly").tolist()))
+    assert key(s_rows) == key(d_rows)
+
+
+def test_distributed_view_collapses_across_shards(batch):
+    single = FlowDatabase()
+    single.insert_flows(batch)
+    sharded = ShardedFlowDatabase(n_shards=3, seed=3)
+    sharded.insert_flows(batch)
+    sv = single.views["flows_pod_view"].scan()
+    dv = sharded.views["flows_pod_view"].scan()
+    # identical group keys (decoded) and identical sums
+    def rows(v):
+        out = []
+        for i in range(len(v)):
+            out.append((
+                v.strings("sourcePodName")[i],
+                v.strings("destinationPodName")[i],
+                int(np.asarray(v["timeInserted"])[i]),
+                int(np.asarray(v["throughput"])[i]),
+            ))
+        return sorted(out)
+    assert rows(sv) == rows(dv)
+
+
+def test_retention_monitor_trims_cluster_wide(batch):
+    db = ShardedFlowDatabase(n_shards=2, seed=4)
+    db.insert_flows(batch)
+    mon = db.monitor(capacity_bytes=1,   # force over-threshold
+                     threshold=0.5, delete_percentage=0.5,
+                     skip_rounds=0)
+    n_before = len(db.flows)
+    deleted = mon.tick()
+    assert deleted > 0
+    assert len(db.flows) == n_before - deleted
+    # both shards trimmed at one global boundary: no shard may retain a
+    # row older than the oldest row on any other shard's floor
+    floors = [s.flows.min_value("timeInserted") for s in db.shards
+              if len(s.flows)]
+    remaining = db.flows.scan()
+    assert int(np.asarray(remaining["timeInserted"]).min()) == min(floors)
+
+
+def test_ttl_eviction_fans_out(batch):
+    db = ShardedFlowDatabase(n_shards=2, ttl_seconds=5, seed=5)
+    db.insert_flows(batch)
+    latest = int(np.asarray(batch["timeInserted"]).max())
+    db.evict_ttl(latest + 1000)
+    assert len(db.flows) == 0
+    for name in db.views:
+        assert len(db.views[name].scan()) == 0
+
+
+def test_delete_where_splits_mask_by_shard(batch):
+    db = ShardedFlowDatabase(n_shards=3, seed=6)
+    db.insert_flows(batch)
+    data = db.flows.scan()
+    victim_ip = data.strings("sourceIP")[0]
+    mask = data.strings("sourceIP") == victim_ip
+    deleted = db.flows.delete_where(mask)
+    assert deleted == int(mask.sum()) > 0
+    left = db.flows.scan()
+    assert (left.strings("sourceIP") != victim_ip).all()
+
+
+def test_save_load_roundtrip(tmp_path, batch):
+    db = ShardedFlowDatabase(n_shards=3, seed=7)
+    db.insert_flows(batch)
+    db.tadetector.insert_rows([{"id": "x" * 32, "anomaly": "true"}])
+    path = str(tmp_path / "sharded.npz")
+    db.save(path)
+    back = ShardedFlowDatabase.load(path, n_shards=2)
+    assert _row_keys(back.flows.scan()) == _row_keys(batch)
+    assert len(back.tadetector) == 1
+
+
+# -- multicluster (test/e2e_mc equivalent) ------------------------------
+
+EAST = "11111111-1111-4111-8111-111111111111"
+WEST = "22222222-2222-4222-8222-222222222222"
+
+
+def _two_cluster_db(n_shards=2):
+    db = ShardedFlowDatabase(n_shards=n_shards, seed=8)
+    east = generate_flows(SynthConfig(
+        n_series=8, points_per_series=6, cluster_uuid=EAST, seed=21))
+    west = generate_flows(SynthConfig(
+        n_series=5, points_per_series=6, cluster_uuid=WEST, seed=22))
+    db.insert_flows(east)
+    db.insert_flows(west)
+    return db, east, west
+
+
+def test_multicluster_rows_carry_distinct_uuids():
+    db, east, west = _two_cluster_db()
+    data = db.flows.scan()
+    uuids = data.strings("clusterUUID")
+    assert set(uuids) == {EAST, WEST}
+    assert int((uuids == EAST).sum()) == len(east)
+    assert int((uuids == WEST).sum()) == len(west)
+
+
+def test_multicluster_views_keep_clusters_separate():
+    db, east, west = _two_cluster_db()
+    view = db.views["flows_pod_view"].scan()
+    uuids = view.strings("clusterUUID")
+    assert set(uuids) == {EAST, WEST}
+    # per-cluster throughput sums must match the raw per-cluster data
+    data = db.flows.scan()
+    raw = data.strings("clusterUUID")
+    for uuid in (EAST, WEST):
+        want = int(np.asarray(data["throughput"])[raw == uuid].sum())
+        got = int(np.asarray(view["throughput"])[uuids == uuid].sum())
+        assert got == want
+
+
+def test_multicluster_tad_can_scope_one_cluster():
+    db, east, west = _two_cluster_db()
+    # score everything, then attribute anomalies by cluster of origin:
+    # result rows keep the series identity columns, so a per-cluster
+    # consumer filters its own (the reference CLI filters by the same
+    # identity columns in its retrieve tables)
+    run_tad(db, "EWMA", TadQuerySpec(), tad_id="c" * 32)
+    rows = db.tadetector.scan()
+    assert len(rows) > 0
+    east_ips = set(east.strings("sourceIP"))
+    west_ips = set(west.strings("sourceIP"))
+    for ip in rows.strings("sourceIP"):
+        if ip != "None":
+            assert ip in east_ips | west_ips

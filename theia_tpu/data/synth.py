@@ -44,6 +44,9 @@ class SynthConfig:
     anomaly_fraction: float = 0.1    # fraction of series given a spike
     anomaly_magnitude: float = 20.0  # spike = magnitude * base
     protected_fraction: float = 0.0  # fraction with NP verdicts already set
+    # every record carries the emitting cluster's UUID (multicluster
+    # deployments stamp distinct values, test/e2e_mc/multicluster_test.go)
+    cluster_uuid: str = "8a6a2e0e-0000-4000-8000-000000000001"
     seed: int = 0
 
 
@@ -175,7 +178,7 @@ def generate_flows(cfg: SynthConfig,
         "sourcePodLabels": rep(src_labels),
         "destinationPodLabels": rep(dst_labels),
         "clusterUUID": rep(np.array(
-            ["8a6a2e0e-0000-4000-8000-000000000001"] * S, dtype=object)),
+            [cfg.cluster_uuid] * S, dtype=object)),
         "egressName": rep(np.array([""] * S, dtype=object)),
         "egressIP": rep(np.array([""] * S, dtype=object)),
     }

@@ -258,6 +258,10 @@ class TsvDecoder:
                 raise ValueError(
                     "flow block carries string codes outside its "
                     "dictionary")
+            if n == -5:
+                raise ValueError(
+                    "dictionary desync: block's delta repeats an "
+                    "existing or intra-delta entry")
             if n < 0:
                 raise ValueError(f"malformed flow block ({n})")
             self._sync_dicts()

@@ -33,6 +33,10 @@ def main(argv=None) -> None:
     p.add_argument("--workers", type=int, default=2)
     p.add_argument("--synth", type=int, default=0,
                    help="seed the store with N synthetic series")
+    p.add_argument("--shards", type=int, default=1,
+                   help="flow store shards (the reference's ClickHouse "
+                        "`shards` Helm value; >1 uses the Distributed-"
+                        "table equivalent)")
     p.add_argument("--tls-cert-dir", default=None,
                    help="enable TLS; certs generated/loaded here")
     p.add_argument("--tls-cert", default=None)
@@ -41,14 +45,22 @@ def main(argv=None) -> None:
                    help="issuing CA bundle to publish for provided certs")
     args = p.parse_args(argv)
 
-    from ..store import FlowDatabase
+    from ..store import FlowDatabase, ShardedFlowDatabase
     from .api import API_PORT, TheiaManagerServer
 
     ttl = args.ttl_seconds
     if ttl is None and os.environ.get("THEIA_TTL_SECONDS"):
         ttl = int(os.environ["THEIA_TTL_SECONDS"])
 
-    if args.db:
+    if args.shards > 1:
+        if args.db and os.path.exists(args.db):
+            db = ShardedFlowDatabase.load(args.db,
+                                          n_shards=args.shards,
+                                          ttl_seconds=ttl)
+        else:
+            db = ShardedFlowDatabase(n_shards=args.shards,
+                                     ttl_seconds=ttl)
+    elif args.db:
         try:
             db = FlowDatabase.load(args.db, ttl_seconds=ttl)
         except FileNotFoundError:

@@ -61,8 +61,12 @@ def _pallas_usable() -> bool:
     try:
         from .dbscan_pallas import dbscan_noise_pallas
 
+        # Probe the exact configuration dbscan_scores will run with
+        # (interpreter mode off-TPU), so a forced enable on a CPU host
+        # probes the interpreted kernel, not a doomed Mosaic lowering.
         probe = dbscan_noise_pallas(
-            jnp.zeros((2, 4), jnp.float32), jnp.ones((2, 4), bool))
+            jnp.zeros((2, 4), jnp.float32), jnp.ones((2, 4), bool),
+            interpret=jax.default_backend() not in ("tpu", "axon"))
         jax.block_until_ready(probe)
         return True
     except Exception:

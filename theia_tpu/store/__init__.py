@@ -1,10 +1,13 @@
 """Columnar flow store: tables, materialized views, TTL, retention."""
 
 from .flow_store import FlowDatabase, RetentionMonitor, Table
+from .sharded import (DistributedTable, DistributedView,
+                      ShardedFlowDatabase)
 from .views import (MATERIALIZED_VIEWS, ViewSpec, ViewTable, group_reduce,
                     group_sum)
 
 __all__ = [
     "FlowDatabase", "RetentionMonitor", "Table",
+    "DistributedTable", "DistributedView", "ShardedFlowDatabase",
     "MATERIALIZED_VIEWS", "ViewSpec", "ViewTable", "group_reduce", "group_sum",
 ]

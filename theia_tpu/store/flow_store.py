@@ -139,15 +139,24 @@ class Table:
         Runs entirely under the table lock so a concurrent insert can
         neither be dropped nor half-filtered."""
         with self._lock:
-            if not self._batches:
-                return 0
-            data = (self._batches[0] if len(self._batches) == 1
-                    else ColumnarBatch.concat(self._batches))
-            if len(mask) != len(data):
+            return self._delete_where_locked(mask)
+
+    def _delete_where_locked(self, mask: np.ndarray) -> int:
+        """Body of delete_where; caller must hold self._lock (the
+        sharded store holds every shard's lock to apply one logical
+        mask atomically across shards)."""
+        if not self._batches:
+            if len(mask) != 0:
                 raise ValueError(
-                    f"mask length {len(mask)} != table length {len(data)}")
-            kept = data.filter(~mask)
-            self._batches = [kept] if len(kept) else []
+                    f"mask length {len(mask)} != table length 0")
+            return 0
+        data = (self._batches[0] if len(self._batches) == 1
+                else ColumnarBatch.concat(self._batches))
+        if len(mask) != len(data):
+            raise ValueError(
+                f"mask length {len(mask)} != table length {len(data)}")
+        kept = data.filter(~mask)
+        self._batches = [kept] if len(kept) else []
         return int(mask.sum())
 
     def delete_older_than(self, boundary: int,

@@ -1,0 +1,277 @@
+"""Sharded flow database — the Distributed-table tier.
+
+Re-provides the reference's ClickHouse scale-out topology
+(build/charts/theia/provisioning/datasources/create_table.sh:387-403:
+`Distributed('{cluster}', default, <table>_local, rand())` over
+`shards` from values.yaml:121-126): every logical table is backed by N
+independent shards; inserts are routed row-wise by a uniform random
+assignment (the `rand()` sharding key), reads fan out to every shard
+and merge. Materialized views aggregate per shard on the insert path —
+exactly like ClickHouse, where the MV populates <view>_local on the
+shard the row landed on — and the distributed view read re-collapses
+identical group keys across shards at query time.
+
+Multicluster works the same way it does in the reference
+(test/e2e_mc/multicluster_test.go:37-80): flow sources in different
+clusters stamp their own `clusterUUID`, all rows land in one logical
+store, and every consumer filters or groups by that column.
+
+Each shard owns its dictionaries (shards are independent processes in a
+real deployment); cross-shard merges re-encode through
+ColumnarBatch.concat's dictionary reconciliation.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..schema import ColumnarBatch
+from .flow_store import FlowDatabase, RetentionMonitor
+from .views import MATERIALIZED_VIEWS, group_sum
+
+
+class DistributedTable:
+    """Read/write facade over one table across all shards."""
+
+    def __init__(self, name: str, tables: Sequence, rng) -> None:
+        self.name = name
+        self.tables = list(tables)
+        self._rng = rng
+        self._lock = threading.Lock()
+
+    @property
+    def schema(self):
+        return self.tables[0].schema
+
+    def __len__(self) -> int:
+        return sum(len(t) for t in self.tables)
+
+    @property
+    def nbytes(self) -> int:
+        return sum(t.nbytes for t in self.tables)
+
+    def _assign(self, n: int) -> np.ndarray:
+        with self._lock:   # rand() routing; rng isn't thread-safe
+            return self._rng.integers(0, len(self.tables), size=n)
+
+    def insert(self, batch: ColumnarBatch) -> int:
+        if len(batch) == 0:
+            return 0
+        assign = self._assign(len(batch))
+        for i, table in enumerate(self.tables):
+            part = batch.filter(assign == i)
+            if len(part):
+                table.insert(part)
+        return len(batch)
+
+    def insert_rows(self, rows) -> int:
+        if not rows:
+            return 0
+        assign = self._assign(len(rows))
+        for i, table in enumerate(self.tables):
+            table.insert_rows([r for r, a in zip(rows, assign)
+                               if a == i])
+        return len(rows)
+
+    def scan(self) -> ColumnarBatch:
+        parts = [t.scan() for t in self.tables]
+        return ColumnarBatch.concat(parts)
+
+    def select(self, *a, **kw) -> ColumnarBatch:
+        return ColumnarBatch.concat(
+            [t.select(*a, **kw) for t in self.tables])
+
+    def delete_where(self, mask: np.ndarray) -> int:
+        """Delete by a mask over the scan() row order (shard order).
+
+        Holds every shard's lock for the whole operation (in shard
+        order, so no lock-order inversion) — lengths cannot shift
+        between the split and the apply, preserving the single-node
+        all-or-nothing contract against concurrent inserts."""
+        with contextlib.ExitStack() as stack:
+            for t in self.tables:
+                stack.enter_context(t._lock)
+            lengths = [sum(len(b) for b in t._batches)
+                       for t in self.tables]
+            if len(mask) != sum(lengths):
+                raise ValueError(
+                    f"mask length {len(mask)} != table length "
+                    f"{sum(lengths)}")
+            deleted, off = 0, 0
+            for t, n in zip(self.tables, lengths):
+                part = mask[off:off + n]
+                off += n
+                deleted += t._delete_where_locked(part)
+            return deleted
+
+    def delete_older_than(self, boundary: int,
+                          column: str = "timeInserted") -> int:
+        return sum(t.delete_older_than(boundary, column)
+                   for t in self.tables)
+
+    def min_value(self, column: str = "timeInserted") -> Optional[int]:
+        mins = [m for m in (t.min_value(column) for t in self.tables)
+                if m is not None]
+        return min(mins) if mins else None
+
+    def truncate(self) -> None:
+        for t in self.tables:
+            t.truncate()
+
+
+class DistributedView:
+    """Merged read view over one materialized view across shards."""
+
+    def __init__(self, name: str, views: Sequence) -> None:
+        self.name = name
+        self.views = list(views)
+        self.spec = views[0].spec
+
+    def __len__(self) -> int:
+        return len(self.scan())
+
+    def scan(self) -> ColumnarBatch:
+        """Concat shard views, then collapse identical group keys (the
+        SummingMergeTree merge across shards happens at read time for
+        Distributed views)."""
+        merged = ColumnarBatch.concat([v.scan() for v in self.views])
+        if len(merged) == 0:
+            return merged
+        keys = np.stack([np.asarray(merged[c], np.int64)
+                         for c in self.spec.key_columns], axis=1)
+        values = np.stack([np.asarray(merged[c], np.int64)
+                           for c in self.spec.sum_columns], axis=1)
+        gk, gv = group_sum(keys, values)
+        cols: Dict[str, np.ndarray] = {}
+        for i, name in enumerate(self.spec.key_columns):
+            cols[name] = gk[:, i].astype(
+                np.int32 if name in merged.dicts else np.int64)
+        for i, name in enumerate(self.spec.sum_columns):
+            cols[name] = gv[:, i]
+        return ColumnarBatch(
+            cols, {n: d for n, d in merged.dicts.items()
+                   if n in self.spec.key_columns})
+
+    def delete_older_than(self, boundary: int) -> int:
+        return sum(v.delete_older_than(boundary) for v in self.views)
+
+    def truncate(self) -> None:
+        for v in self.views:
+            v.truncate()
+
+
+class ShardedFlowDatabase:
+    """N-shard logical database with the FlowDatabase consumer surface.
+
+    Analytics jobs, the manager, dashboards, and stats all run
+    unmodified against this class — the same way the reference's
+    consumers query the Distributed tables and never the `_local` ones.
+    """
+
+    def __init__(self, n_shards: int = 2,
+                 ttl_seconds: Optional[int] = None,
+                 seed: int = 0) -> None:
+        if n_shards < 1:
+            raise ValueError("n_shards must be >= 1")
+        self.shards: List[FlowDatabase] = [
+            FlowDatabase(ttl_seconds=ttl_seconds)
+            for _ in range(n_shards)]
+        # One Generator per table: each DistributedTable serializes its
+        # own rand() stream under its own lock; sharing one Generator
+        # across tables would race (Generators are not thread-safe).
+        seqs = np.random.SeedSequence(seed).spawn(3)
+        self.ttl_seconds = ttl_seconds
+        self.flows = DistributedTable(
+            "flows", [s.flows for s in self.shards],
+            np.random.default_rng(seqs[0]))
+        self.tadetector = DistributedTable(
+            "tadetector", [s.tadetector for s in self.shards],
+            np.random.default_rng(seqs[1]))
+        self.recommendations = DistributedTable(
+            "recommendations",
+            [s.recommendations for s in self.shards],
+            np.random.default_rng(seqs[2]))
+        self.views: Dict[str, DistributedView] = {
+            name: DistributedView(name,
+                                  [s.views[name] for s in self.shards])
+            for name in MATERIALIZED_VIEWS}
+
+    @property
+    def n_shards(self) -> int:
+        return len(self.shards)
+
+    # -- ingest ----------------------------------------------------------
+
+    def insert_flows(self, batch: ColumnarBatch,
+                     now: Optional[int] = None) -> int:
+        """Route rows to shards (rand()); each shard maintains its own
+        views/TTL on its slice, like a ClickHouse shard does."""
+        if len(batch) == 0:
+            return 0
+        assign = self.flows._assign(len(batch))
+        inserted = 0
+        for i, shard in enumerate(self.shards):
+            part = batch.filter(assign == i)
+            if len(part):
+                inserted += shard.insert_flows(part, now=now)
+        return inserted
+
+    def insert_flow_rows(self, rows, now: Optional[int] = None) -> int:
+        from ..schema import FLOW_SCHEMA
+        if not rows:
+            return 0
+        return self.insert_flows(
+            ColumnarBatch.from_rows(rows, FLOW_SCHEMA), now=now)
+
+    # -- retention --------------------------------------------------------
+
+    def evict_ttl(self, now: int) -> int:
+        return sum(s.evict_ttl(now) for s in self.shards)
+
+    def delete_flows_older_than(self, boundary: int) -> int:
+        return sum(s.delete_flows_older_than(boundary)
+                   for s in self.shards)
+
+    def monitor(self, capacity_bytes: int, **kw) -> RetentionMonitor:
+        # RetentionMonitor only touches .flows.{nbytes,scan} and
+        # .delete_flows_older_than — all provided here, so monitoring a
+        # sharded database trims every shard at one global boundary
+        # (the reference monitor runs the boundary query cluster-wide).
+        return RetentionMonitor(self, capacity_bytes, **kw)
+
+    # -- persistence ------------------------------------------------------
+
+    def save(self, path: str) -> None:
+        """Persist the *logical* contents as one single-node snapshot
+        (FlowDatabase format); loading re-shards. Mirrors backing up a
+        cluster through the Distributed table."""
+        merged = FlowDatabase()
+        flows = self.flows.scan()
+        if len(flows):
+            merged.flows.insert(flows)
+        for src, dst in ((self.tadetector, merged.tadetector),
+                         (self.recommendations, merged.recommendations)):
+            data = src.scan()
+            if len(data):
+                dst.insert(data)
+        merged.save(path)
+
+    @classmethod
+    def load(cls, path: str, n_shards: int = 2,
+             ttl_seconds: Optional[int] = None,
+             seed: int = 0) -> "ShardedFlowDatabase":
+        single = FlowDatabase.load(path)
+        db = cls(n_shards=n_shards, ttl_seconds=ttl_seconds, seed=seed)
+        flows = single.flows.scan()
+        if len(flows):
+            db.insert_flows(flows)
+        for src, dst in ((single.tadetector, db.tadetector),
+                         (single.recommendations, db.recommendations)):
+            data = src.scan()
+            if len(data):
+                dst.insert(data)
+        return db
