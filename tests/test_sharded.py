@@ -86,15 +86,20 @@ def test_retention_monitor_trims_cluster_wide(batch):
                      threshold=0.5, delete_percentage=0.5,
                      skip_rounds=0)
     n_before = len(db.flows)
+    # the global boundary tick() will use: timeInserted of the last row
+    # in the oldest delete_percentage (monitor main.go:301-318)
+    t_sorted = np.sort(np.asarray(db.flows.scan()["timeInserted"]))
+    boundary = int(t_sorted[int(n_before * 0.5) - 1])
     deleted = mon.tick()
     assert deleted > 0
     assert len(db.flows) == n_before - deleted
-    # both shards trimmed at one global boundary: no shard may retain a
-    # row older than the oldest row on any other shard's floor
-    floors = [s.flows.min_value("timeInserted") for s in db.shards
-              if len(s.flows)]
-    remaining = db.flows.scan()
-    assert int(np.asarray(remaining["timeInserted"]).min()) == min(floors)
+    # EVERY shard was trimmed at that one global boundary — a monitor
+    # that trims only one shard leaves another shard's floor below it
+    for s in db.shards:
+        if len(s.flows):
+            assert s.flows.min_value("timeInserted") >= boundary
+    # and exactly the strictly-older rows are gone
+    assert deleted == int((t_sorted < boundary).sum())
 
 
 def test_ttl_eviction_fans_out(batch):
@@ -171,16 +176,76 @@ def test_multicluster_views_keep_clusters_separate():
 
 
 def test_multicluster_tad_can_scope_one_cluster():
-    db, east, west = _two_cluster_db()
-    # score everything, then attribute anomalies by cluster of origin:
-    # result rows keep the series identity columns, so a per-cluster
-    # consumer filters its own (the reference CLI filters by the same
-    # identity columns in its retrieve tables)
-    run_tad(db, "EWMA", TadQuerySpec(), tad_id="c" * 32)
+    """TadQuerySpec.cluster_uuid restricts scoring to one cluster's
+    rows: only EAST carries injected spikes, so the EAST-scoped run
+    must find them and the WEST-scoped run must find none — even though
+    the two clusters' pods share an IP space."""
+    db = ShardedFlowDatabase(n_shards=2, seed=8)
+    east = generate_flows(SynthConfig(
+        n_series=8, points_per_series=12, cluster_uuid=EAST,
+        anomaly_fraction=0.5, anomaly_magnitude=40.0, seed=21))
+    west = generate_flows(SynthConfig(
+        n_series=5, points_per_series=12, cluster_uuid=WEST,
+        anomaly_fraction=0.0, seed=22))
+    db.insert_flows(east)
+    db.insert_flows(west)
+
+    east_keys = set(zip(east.strings("sourceIP"),
+                        np.asarray(east["sourceTransportPort"])))
+    west_keys = set(zip(west.strings("sourceIP"),
+                        np.asarray(west["sourceTransportPort"])))
+    # the ⊆ assertions below are only meaningful if the key sets don't
+    # overlap (deterministic for these seeds)
+    assert not east_keys & west_keys
+
+    run_tad(db, "EWMA", TadQuerySpec(cluster_uuid=EAST),
+            tad_id="c" * 32)
+    east_rows = db.tadetector.scan()
+    assert len(east_rows) > 0
+    for ip, port in zip(east_rows.strings("sourceIP"),
+                        np.asarray(east_rows["sourceTransportPort"])):
+        assert (ip, port) in east_keys
+    # the injected 40x spikes are attributed to EAST
+    assert np.asarray(east_rows["throughput"]).max() > 20 * 1.0e6
+
+    db.tadetector.truncate()
+    run_tad(db, "EWMA", TadQuerySpec(cluster_uuid=WEST),
+            tad_id="d" * 32)
+    west_rows = db.tadetector.scan()
+    # WEST's only flags are the EWMA cold-start artifact (e_0 = x_0/2,
+    # reference semantics) — never a spike, and never an EAST series.
+    for ip, port in zip(west_rows.strings("sourceIP"),
+                        np.asarray(west_rows["sourceTransportPort"])):
+        assert (ip, port) in west_keys
+    if len(west_rows):
+        assert np.asarray(west_rows["throughput"]).max() < 5 * 1.0e6
+
+    # scoping to an unknown cluster matches nothing → the reference's
+    # "NO ANOMALY DETECTED" filler row and nothing else
+    db.tadetector.truncate()
+    run_tad(db, "EWMA",
+            TadQuerySpec(cluster_uuid="0" * 8 + "-dead-4bee-8f00-"
+                         + "0" * 12),
+            tad_id="e" * 32)
     rows = db.tadetector.scan()
-    assert len(rows) > 0
-    east_ips = set(east.strings("sourceIP"))
-    west_ips = set(west.strings("sourceIP"))
-    for ip in rows.strings("sourceIP"):
-        if ip != "None":
-            assert ip in east_ips | west_ips
+    assert len(rows) == 1
+    assert rows.strings("anomaly")[0] == "NO ANOMALY DETECTED"
+
+
+def test_sharded_load_defers_ttl_eviction(tmp_path, batch):
+    """Loading a snapshot with a TTL must not evict persisted rows
+    during the re-insert (parity with FlowDatabase.load)."""
+    db = ShardedFlowDatabase(n_shards=2, seed=9)
+    db.insert_flows(batch)
+    span = (int(np.asarray(batch["timeInserted"]).max())
+            - int(np.asarray(batch["timeInserted"]).min()))
+    path = str(tmp_path / "ttl.npz")
+    db.save(path)
+    back = ShardedFlowDatabase.load(path, n_shards=3,
+                                    ttl_seconds=max(span // 2, 1))
+    assert len(back.flows) == len(batch)
+    assert back.ttl_seconds == max(span // 2, 1)
+    # ...but TTL is armed for subsequent ingest
+    latest = int(np.asarray(batch["timeInserted"]).max())
+    back.evict_ttl(latest + span + 10_000)
+    assert len(back.flows) == 0

@@ -52,6 +52,10 @@ class TadQuerySpec:
     pod_namespace: str = ""
     external_ip: str = ""
     svc_port_name: str = ""
+    # Scope the query to one cluster's rows in a multicluster store
+    # (rows carry the emitting cluster's UUID, test/e2e_mc). Empty =
+    # all clusters, like the reference job's unfiltered SQL.
+    cluster_uuid: str = ""
 
     @property
     def agg_type(self) -> str:
@@ -156,6 +160,10 @@ def build_series(flows: ColumnarBatch, spec: TadQuerySpec,
                  dtype=np.float64) -> SeriesBatch:
     """Build the padded series batch for one TAD query."""
     base = _ns_ignore_mask(flows, spec.ns_ignore_list)
+    if spec.cluster_uuid:
+        code = flows.dicts["clusterUUID"].lookup(spec.cluster_uuid)
+        base &= (np.asarray(flows["clusterUUID"])
+                 == (-1 if code is None else code))
     if spec.agg_flow == "pod":
         return _build_pod_series(flows, spec, base, dtype)
 

@@ -199,6 +199,7 @@ def tad_run(args) -> None:
         "podNameSpace": args.pod_namespace or None,
         "externalIp": args.external_ip or None,
         "servicePortName": args.svc_port_name or None,
+        "clusterUUID": args.cluster_uuid or None,
         "executorInstances": args.executor_instances,
     }
     body = {k: v for k, v in body.items() if v is not None}
@@ -399,6 +400,8 @@ def build_parser() -> argparse.ArgumentParser:
                          default="")
         run.add_argument("--external-ip", dest="external_ip", default="")
         run.add_argument("--svc-port-name", dest="svc_port_name",
+                         default="")
+        run.add_argument("--cluster-uuid", dest="cluster_uuid",
                          default="")
         run.add_argument("--executor-instances",
                          dest="executor_instances", type=int, default=1)

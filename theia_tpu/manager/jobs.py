@@ -229,7 +229,9 @@ class JobController:
                             spec.get("podNameSpace", "") or ""),
                         external_ip=str(spec.get("externalIp", "") or ""),
                         svc_port_name=str(
-                            spec.get("servicePortName", "") or "")),
+                            spec.get("servicePortName", "") or ""),
+                        cluster_uuid=str(
+                            spec.get("clusterUUID", "") or "")),
                     tad_id=record.job_id,
                     progress=record.progress)
             else:

@@ -44,6 +44,13 @@ def dbscan_noise(x: jnp.ndarray, mask: jnp.ndarray,
     return mask & ~core & ~reachable
 
 
+def _interpret() -> bool:
+    """Pallas interpreter mode: on for any backend that can't lower
+    Mosaic (everything but real TPU). One definition shared by the
+    probe and the run path so they can never drift."""
+    return jax.default_backend() not in ("tpu", "axon")
+
+
 @functools.lru_cache(maxsize=1)
 def _pallas_usable() -> bool:
     """One-time probe: can the Pallas kernel compile+run on the default
@@ -66,7 +73,7 @@ def _pallas_usable() -> bool:
         # probes the interpreted kernel, not a doomed Mosaic lowering.
         probe = dbscan_noise_pallas(
             jnp.zeros((2, 4), jnp.float32), jnp.ones((2, 4), bool),
-            interpret=jax.default_backend() not in ("tpu", "axon"))
+            interpret=_interpret())
         jax.block_until_ready(probe)
         return True
     except Exception:
@@ -96,7 +103,7 @@ def dbscan_scores(x: jnp.ndarray, mask: jnp.ndarray,
         # interpreter mode (same code path, testable on the CPU mesh).
         anomaly = dbscan_noise_pallas(
             x, mask, eps=eps, min_samples=min_samples,
-            interpret=jax.default_backend() not in ("tpu", "axon"))
+            interpret=_interpret())
     else:
         anomaly = dbscan_noise(x, mask, eps=eps,
                                min_samples=min_samples)

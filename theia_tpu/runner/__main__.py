@@ -77,6 +77,9 @@ def build_parser() -> argparse.ArgumentParser:
                      default="")
     tad.add_argument("-p", "--svc-port-name", dest="svc_port_name",
                      default="")
+    tad.add_argument("-c", "--cluster-uuid", dest="cluster_uuid",
+                     default="",
+                     help="scope to one cluster in a multicluster store")
     tad.add_argument("--progress-file", default=None)
 
     npr = sub.add_parser("npr", help="network policy recommendation")
@@ -111,6 +114,7 @@ def run_tad_job(args) -> str:
         pod_namespace=args.pod_namespace,
         external_ip=args.external_ip,
         svc_port_name=args.svc_port_name,
+        cluster_uuid=args.cluster_uuid,
     )
     if args.pod_namespace and not (args.pod_label or args.pod_name):
         raise SystemExit(

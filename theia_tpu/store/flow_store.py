@@ -321,10 +321,15 @@ class FlowDatabase:
 
     @classmethod
     def load(cls, path: str,
-             ttl_seconds: Optional[int] = None) -> "FlowDatabase":
+             ttl_seconds: Optional[int] = None,
+             build_views: bool = True) -> "FlowDatabase":
         """Load a persisted database, migrating older schema versions
         up to current first (the reference's schema-management init
-        container runs before the server the same way)."""
+        container runs before the server the same way).
+
+        build_views=False skips materialized-view fan-out — for callers
+        that immediately re-insert the rows elsewhere (sharded load)
+        and would otherwise pay the O(rows) view build twice."""
         from .migration import migrate
         db = cls(ttl_seconds=None)
         with np.load(path, allow_pickle=True) as z:
@@ -346,7 +351,7 @@ class FlowDatabase:
                     {c.name: cols.get(c.name, np.zeros(
                         len(next(iter(cols.values()))), c.host_dtype))
                      for c in table.schema}, table.dicts)
-                if table is db.flows:
+                if table is db.flows and build_views:
                     db.insert_flows(batch)
                 else:
                     table.insert(batch)

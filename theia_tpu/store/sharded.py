@@ -31,7 +31,7 @@ import numpy as np
 
 from ..schema import ColumnarBatch
 from .flow_store import FlowDatabase, RetentionMonitor
-from .views import MATERIALIZED_VIEWS, group_sum
+from .views import MATERIALIZED_VIEWS, group_sum, materialize_view_batch
 
 
 class DistributedTable:
@@ -146,15 +146,7 @@ class DistributedView:
         values = np.stack([np.asarray(merged[c], np.int64)
                            for c in self.spec.sum_columns], axis=1)
         gk, gv = group_sum(keys, values)
-        cols: Dict[str, np.ndarray] = {}
-        for i, name in enumerate(self.spec.key_columns):
-            cols[name] = gk[:, i].astype(
-                np.int32 if name in merged.dicts else np.int64)
-        for i, name in enumerate(self.spec.sum_columns):
-            cols[name] = gv[:, i]
-        return ColumnarBatch(
-            cols, {n: d for n, d in merged.dicts.items()
-                   if n in self.spec.key_columns})
+        return materialize_view_batch(self.spec, gk, gv, merged.dicts)
 
     def delete_older_than(self, boundary: int) -> int:
         return sum(v.delete_older_than(boundary) for v in self.views)
@@ -264,8 +256,12 @@ class ShardedFlowDatabase:
     def load(cls, path: str, n_shards: int = 2,
              ttl_seconds: Optional[int] = None,
              seed: int = 0) -> "ShardedFlowDatabase":
-        single = FlowDatabase.load(path)
-        db = cls(n_shards=n_shards, ttl_seconds=ttl_seconds, seed=seed)
+        single = FlowDatabase.load(path, build_views=False)
+        # Defer TTL until every row is back in, exactly like
+        # FlowDatabase.load (flow_store.py) — otherwise the re-insert
+        # itself evicts persisted rows, at a routing-dependent boundary
+        # per shard.
+        db = cls(n_shards=n_shards, ttl_seconds=None, seed=seed)
         flows = single.flows.scan()
         if len(flows):
             db.insert_flows(flows)
@@ -274,4 +270,7 @@ class ShardedFlowDatabase:
             data = src.scan()
             if len(data):
                 dst.insert(data)
+        db.ttl_seconds = ttl_seconds
+        for shard in db.shards:
+            shard.ttl_seconds = ttl_seconds
         return db

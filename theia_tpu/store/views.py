@@ -56,6 +56,24 @@ def group_sum(keys: np.ndarray, values: np.ndarray
     return group_reduce(keys, values, "sum")
 
 
+def materialize_view_batch(spec: "ViewSpec", keys: np.ndarray,
+                           values: np.ndarray,
+                           dicts: Dict[str, StringDictionary]
+                           ) -> ColumnarBatch:
+    """(keys [g,k], values [g,m]) → a ColumnarBatch in the view's row
+    shape. The single materialization point for view reads — ViewTable
+    (single node) and DistributedView (sharded) both go through it, so
+    the two read paths cannot drift."""
+    cols: Dict[str, np.ndarray] = {}
+    for i, name in enumerate(spec.key_columns):
+        cols[name] = keys[:, i].astype(
+            np.int32 if name in dicts else np.int64)
+    for i, name in enumerate(spec.sum_columns):
+        cols[name] = values[:, i]
+    return ColumnarBatch(
+        cols, {n: dicts[n] for n in spec.key_columns if n in dicts})
+
+
 @dataclasses.dataclass(frozen=True)
 class ViewSpec:
     key_columns: Tuple[str, ...]
@@ -162,15 +180,8 @@ class ViewTable:
     def scan(self) -> ColumnarBatch:
         """The view as a ColumnarBatch (keys + summed metrics)."""
         keys, values = self._merged()
-        cols: Dict[str, np.ndarray] = {}
-        for i, name in enumerate(self.spec.key_columns):
-            cols[name] = keys[:, i].astype(
-                np.int32 if name in self.dicts else np.int64)
-        for i, name in enumerate(self.spec.sum_columns):
-            cols[name] = values[:, i]
-        return ColumnarBatch(
-            cols, {n: self.dicts[n] for n in self.spec.key_columns
-                   if n in self.dicts})
+        return materialize_view_batch(self.spec, keys, values,
+                                      self.dicts)
 
     def delete_older_than(self, boundary: int) -> int:
         """Drop view rows with timeInserted < boundary (retention trim
