@@ -13,9 +13,11 @@ and emit policy YAML for the three isolation options
   3 — K8s NetworkPolicies, no deny rules
 
 TPU-first note: the numeric kernel here is the DISTINCT over the 9-tuple
-— a segment-dedupe over dictionary codes handled by the store's
-vectorized group_reduce; everything after operates on the (small)
-deduplicated set and is host-side string/YAML work, as in the reference.
+— executed on device for large windows via `npr_device.device_distinct`
+(lax.sort multi-key dedupe; sharded variant merges per-chip distincts
+with an all_gather + segment-sum, the collective replacing the Spark
+shuffle); everything after operates on the (small) deduplicated set and
+is host-side string/YAML work, as in the reference.
 """
 
 from __future__ import annotations
@@ -28,8 +30,8 @@ import numpy as np
 
 from ..schema import ColumnarBatch
 from ..store import FlowDatabase
-from ..store.views import group_reduce
 from . import policy_gen
+from .npr_device import device_distinct
 from .policy_gen import (
     KIND_ACG,
     KIND_ACNP,
@@ -89,7 +91,7 @@ def read_distinct_flows(flows: ColumnarBatch,
 
     keys = np.stack([np.asarray(sub[c], np.int64)
                      for c in FLOW_TABLE_COLUMNS], axis=1)
-    uniq, _ = group_reduce(keys, np.zeros((keys.shape[0], 1), np.int64))
+    uniq, _counts = device_distinct(keys)
 
     rows: List[Dict[str, object]] = []
     for r in uniq:

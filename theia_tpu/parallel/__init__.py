@@ -1,9 +1,17 @@
 """Device meshes and sharded scoring."""
 
-from .mesh import SERIES_AXIS, TIME_AXIS, make_mesh, pad_to_multiple
+from .mesh import (
+    ROWS_AXIS,
+    SERIES_AXIS,
+    TIME_AXIS,
+    make_mesh,
+    make_rows_mesh,
+    pad_to_multiple,
+)
 from .tad_sharded import make_sharded_ewma, shard_arrays
 
 __all__ = [
-    "SERIES_AXIS", "TIME_AXIS", "make_mesh", "pad_to_multiple",
+    "ROWS_AXIS", "SERIES_AXIS", "TIME_AXIS", "make_mesh",
+    "make_rows_mesh", "pad_to_multiple",
     "make_sharded_ewma", "shard_arrays",
 ]

@@ -27,6 +27,7 @@ from jax.sharding import Mesh
 
 SERIES_AXIS = "series"
 TIME_AXIS = "time"
+ROWS_AXIS = "rows"
 
 
 def make_mesh(n_devices: Optional[int] = None,
@@ -46,6 +47,16 @@ def make_mesh(n_devices: Optional[int] = None,
             f"time_shards {time_shards} must divide device count {n}")
     grid = np.asarray(devs).reshape(n // time_shards, time_shards)
     return Mesh(grid, (SERIES_AXIS, TIME_AXIS))
+
+
+def make_rows_mesh(n_devices: Optional[int] = None,
+                   devices: Optional[Sequence] = None) -> Mesh:
+    """A 1-D `rows` mesh — data parallelism over flow-record blocks
+    (the NPR job's distinct/support-count shuffle axis)."""
+    devs = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (ROWS_AXIS,))
 
 
 def pad_to_multiple(arr: np.ndarray, multiple: int, axis: int,
