@@ -1,0 +1,160 @@
+// Native series builder: group flow rows by an integer key tuple into
+// padded per-series time arrays — the host tensorize step of the TAD
+// job (theia_tpu/analytics/series.py). Replaces two numpy lexsorts
+// (group_reduce + _pack_and_pad) with one hash-group pass + per-group
+// sorts; semantics are bit-identical to the numpy path:
+//
+//   * duplicate (key, time) rows reduce with op (0 = max, 1 = sum) —
+//     the reference job's max(throughput)/sum(throughput) stage
+//     (plugins/anomaly-detection/anomaly_detection.py:507-614);
+//   * series are emitted in lexicographic key order, points in time
+//     order, padded to the longest series with a validity mask.
+//
+// Exposed via ctypes (no pybind11 in the image) from the same shared
+// object as the flowblock decoder; see theia_tpu/ingest/native.py.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct Builder {
+  int64_t S = 0, T = 0, k = 0;
+  std::vector<int64_t> group_keys;  // S*k, lexicographically sorted
+  // per series: (time, value), time-sorted, duplicate times merged
+  std::vector<std::vector<std::pair<int64_t, int64_t>>> series;
+};
+
+inline uint64_t hash_row(const int64_t* row, int64_t k) {
+  uint64_t h = 1469598103934665603ull;  // FNV offset basis
+  for (int64_t i = 0; i < k; ++i) {
+    uint64_t x = static_cast<uint64_t>(row[i]);
+    x *= 0xff51afd7ed558ccdull;  // splitmix-style scramble per word
+    x ^= x >> 33;
+    h ^= x;
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+
+}  // namespace
+
+extern "C" {
+
+// keys: [n, k] row-major int64; times/values: [n] int64.
+// op: 0 = max, 1 = sum for duplicate (key, time) rows.
+void* sb_build(const int64_t* keys, const int64_t* times,
+               const int64_t* values, int64_t n, int64_t k, int32_t op) {
+  auto* b = new Builder();
+  b->k = k;
+  if (n == 0) return b;
+
+  // Open-addressing map: slot -> (representative row, group id).
+  size_t cap = 1;
+  while (cap < static_cast<size_t>(n) * 2) cap <<= 1;
+  std::vector<int64_t> slot_row(cap, -1);
+  std::vector<int32_t> slot_gid(cap, -1);
+  std::vector<int64_t> rep_rows;
+  std::vector<std::vector<std::pair<int64_t, int64_t>>> groups;
+
+  for (int64_t r = 0; r < n; ++r) {
+    const int64_t* row = keys + r * k;
+    uint64_t h = hash_row(row, k) & (cap - 1);
+    int32_t gid = -1;
+    for (;;) {
+      if (slot_row[h] < 0) {
+        gid = static_cast<int32_t>(groups.size());
+        slot_row[h] = r;
+        slot_gid[h] = gid;
+        rep_rows.push_back(r);
+        groups.emplace_back();
+        break;
+      }
+      if (!memcmp(keys + slot_row[h] * k, row,
+                  static_cast<size_t>(k) * sizeof(int64_t))) {
+        gid = slot_gid[h];
+        break;
+      }
+      h = (h + 1) & (cap - 1);
+    }
+    groups[gid].emplace_back(times[r], values[r]);
+  }
+
+  // Emit groups in lexicographic key order (np.lexsort parity).
+  const int64_t S = static_cast<int64_t>(groups.size());
+  std::vector<int32_t> order(S);
+  for (int64_t i = 0; i < S; ++i) order[i] = static_cast<int32_t>(i);
+  std::sort(order.begin(), order.end(), [&](int32_t a, int32_t c) {
+    const int64_t* ra = keys + rep_rows[a] * k;
+    const int64_t* rc = keys + rep_rows[c] * k;
+    for (int64_t i = 0; i < k; ++i)
+      if (ra[i] != rc[i]) return ra[i] < rc[i];
+    return false;
+  });
+
+  b->S = S;
+  b->group_keys.resize(static_cast<size_t>(S) * k);
+  b->series.resize(S);
+  int64_t T = 0;
+  for (int64_t gi = 0; gi < S; ++gi) {
+    const int32_t g = order[gi];
+    memcpy(&b->group_keys[gi * k], keys + rep_rows[g] * k,
+           static_cast<size_t>(k) * sizeof(int64_t));
+    auto& pts = groups[g];
+    std::sort(pts.begin(), pts.end(),
+              [](const std::pair<int64_t, int64_t>& x,
+                 const std::pair<int64_t, int64_t>& y) {
+                return x.first < y.first;
+              });
+    auto& out = b->series[gi];
+    out.reserve(pts.size());
+    for (const auto& p : pts) {
+      if (!out.empty() && out.back().first == p.first) {
+        if (op == 0)
+          out.back().second = std::max(out.back().second, p.second);
+        else
+          out.back().second += p.second;
+      } else {
+        out.push_back(p);
+      }
+    }
+    T = std::max<int64_t>(T, static_cast<int64_t>(out.size()));
+  }
+  b->T = T;
+  return b;
+}
+
+void sb_dims(void* h, int64_t* S, int64_t* T) {
+  auto* b = static_cast<Builder*>(h);
+  *S = b->S;
+  *T = b->T;
+}
+
+// out_keys: [S, k] int64; out_values: [S, T] double;
+// out_times: [S, T] int64; out_mask: [S, T] uint8. Caller-allocated.
+void sb_fill(void* h, int64_t* out_keys, double* out_values,
+             int64_t* out_times, uint8_t* out_mask) {
+  auto* b = static_cast<Builder*>(h);
+  const int64_t S = b->S, T = b->T, k = b->k;
+  if (S && k)
+    memcpy(out_keys, b->group_keys.data(),
+           static_cast<size_t>(S) * k * sizeof(int64_t));
+  if (!S || !T) return;
+  memset(out_values, 0, static_cast<size_t>(S) * T * sizeof(double));
+  memset(out_times, 0, static_cast<size_t>(S) * T * sizeof(int64_t));
+  memset(out_mask, 0, static_cast<size_t>(S) * T);
+  for (int64_t s = 0; s < S; ++s) {
+    const auto& pts = b->series[s];
+    for (size_t t = 0; t < pts.size(); ++t) {
+      out_values[s * T + t] = static_cast<double>(pts[t].second);
+      out_times[s * T + t] = pts[t].first;
+      out_mask[s * T + t] = 1;
+    }
+  }
+}
+
+void sb_free(void* h) { delete static_cast<Builder*>(h); }
+
+}  // extern "C"

@@ -1,0 +1,93 @@
+"""Native C++ series builder: bit parity with the numpy tensorize."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from theia_tpu.analytics import TadQuerySpec, build_series
+from theia_tpu.analytics.series import _group_and_pad
+from theia_tpu.data.synth import SynthConfig, generate_flows
+from theia_tpu.ingest.native import build_padded_series, native_available
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native library unavailable")
+
+
+def _random_rows(rng, n, k=5, card=7, t_card=12):
+    keys = rng.integers(0, card, size=(n, k)).astype(np.int64)
+    t = rng.integers(100, 100 + t_card, size=n).astype(np.int64)
+    v = rng.integers(1, 10**9, size=n).astype(np.int64)
+    return keys, t, v
+
+
+@pytest.mark.parametrize("op", ["max", "sum"])
+def test_native_matches_numpy_bitwise(monkeypatch, op):
+    rng = np.random.default_rng(3)
+    keys, t, v = _random_rows(rng, 2000)
+
+    native = build_padded_series(keys, t, v, op)
+    assert native is not None
+    monkeypatch.setenv("THEIA_NATIVE_SERIES", "0")
+    ref = _group_and_pad(keys, t, v, op, np.float64)
+
+    for a, b in zip(native, ref):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_native_empty_input():
+    out = build_padded_series(
+        np.zeros((0, 4), np.int64), np.zeros(0, np.int64),
+        np.zeros(0, np.int64), "max")
+    key_mat, values, times, mask = out
+    assert key_mat.shape == (0, 4)
+    assert values.shape == times.shape == mask.shape == (0, 0)
+
+
+def test_native_single_group_duplicate_times():
+    keys = np.zeros((6, 2), np.int64)
+    t = np.array([5, 5, 5, 7, 7, 6], np.int64)
+    v = np.array([10, 30, 20, 1, 2, 9], np.int64)
+    key_mat, values, times, mask = build_padded_series(keys, t, v, "max")
+    assert key_mat.shape == (1, 2)
+    np.testing.assert_array_equal(times[0], [5, 6, 7])
+    np.testing.assert_array_equal(values[0], [30.0, 9.0, 2.0])
+    assert mask.all()
+
+    _, values, _, _ = build_padded_series(keys, t, v, "sum")
+    np.testing.assert_array_equal(values[0], [60.0, 9.0, 3.0])
+
+
+def test_build_series_identical_on_both_paths(monkeypatch):
+    batch = generate_flows(SynthConfig(
+        n_series=24, points_per_series=10, anomaly_fraction=0.2,
+        seed=4))
+
+    def series(flag):
+        monkeypatch.setenv("THEIA_NATIVE_SERIES", flag)
+        return build_series(batch, TadQuerySpec())
+
+    a = series("1")
+    b = series("0")
+    assert a.key_names == b.key_names
+    np.testing.assert_array_equal(a.values, b.values)
+    np.testing.assert_array_equal(a.times, b.times)
+    np.testing.assert_array_equal(a.mask, b.mask)
+    for name in a.key_names:
+        np.testing.assert_array_equal(a.keys[name], b.keys[name])
+
+
+def test_build_pod_series_identical_on_both_paths(monkeypatch):
+    batch = generate_flows(SynthConfig(
+        n_series=24, points_per_series=10, seed=5))
+
+    def series(flag):
+        monkeypatch.setenv("THEIA_NATIVE_SERIES", flag)
+        return build_series(batch, TadQuerySpec(agg_flow="pod"))
+
+    a = series("1")
+    b = series("0")
+    np.testing.assert_array_equal(a.values, b.values)
+    np.testing.assert_array_equal(a.mask, b.mask)
+    for name in a.key_names:
+        np.testing.assert_array_equal(a.keys[name], b.keys[name])

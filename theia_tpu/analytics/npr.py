@@ -87,10 +87,14 @@ def read_distinct_flows(flows: ColumnarBatch,
         mask &= np.asarray(flows["flowStartSeconds"]) >= start_time
     if end_time is not None:
         mask &= np.asarray(flows["flowEndSeconds"]) < end_time
-    sub = flows.filter(mask)
-
-    keys = np.stack([np.asarray(sub[c], np.int64)
-                     for c in FLOW_TABLE_COLUMNS], axis=1)
+    # Materialize only the 9 queried columns (same narrow-column rule
+    # as the series tensorize: filtering all 52 costs more than the
+    # distinct kernel it feeds).
+    full = bool(mask.all())
+    keys = np.stack(
+        [np.asarray(flows[c], np.int64) if full
+         else np.asarray(flows[c], np.int64)[mask]
+         for c in FLOW_TABLE_COLUMNS], axis=1)
     uniq, _counts = device_distinct(keys)
 
     rows: List[Dict[str, object]] = []

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -142,6 +143,29 @@ def _pack_and_pad(key_mat: np.ndarray, t: np.ndarray, v: np.ndarray,
     return sk[starts], values, times, mask
 
 
+def _group_and_pad(key_mat: np.ndarray, t: np.ndarray, v: np.ndarray,
+                   op: str, dtype):
+    """Stage-1 (key,time) reduction + ragged→padded packing.
+
+    One seam with two equivalent implementations: the native C++
+    builder (native/seriesbuild.cc — one hash-group pass; the host
+    tensorize hot path) and the numpy lexsort pipeline. Selected by
+    THEIA_NATIVE_SERIES=auto/1/0 (auto = native when available)."""
+    flag = os.environ.get("THEIA_NATIVE_SERIES", "auto").lower()
+    if flag not in ("0", "off", "false"):
+        from ..ingest.native import build_padded_series
+
+        res = build_padded_series(key_mat, t, v, op, dtype)
+        if res is not None:
+            return res
+        if flag in ("1", "on", "true"):
+            raise RuntimeError("THEIA_NATIVE_SERIES=1 but the native "
+                               "library is unavailable")
+    stage1 = np.concatenate([key_mat, t[:, None]], axis=1)
+    gk, gv = group_reduce(stage1, v[:, None], op)
+    return _pack_and_pad(gk[:, :-1], gk[:, -1], gv[:, 0], dtype)
+
+
 def remove_meaningless_labels(labels_json: str) -> str:
     """Drop autogenerated label keys (reference :631-644); non-JSON
     input → empty string."""
@@ -196,15 +220,18 @@ def build_series(flows: ColumnarBatch, spec: TadQuerySpec,
                      "flowStartSeconds")
         op = "max"
 
-    sub = flows.filter(base)
-    stage1_keys = np.stack(
-        [np.asarray(sub[c], np.int64) for c in key_names]
-        + [np.asarray(sub["flowEndSeconds"], np.int64)], axis=1)
-    thr = np.asarray(sub["throughput"], np.int64)[:, None]
-    gk, gv = group_reduce(stage1_keys, thr, op)
+    # Materialize only the columns this query touches (masking all 52
+    # through ColumnarBatch.filter costs more than the grouping itself
+    # on the tensorize hot path).
+    full = bool(base.all())
 
-    key_mat, values, times, mask = _pack_and_pad(
-        gk[:, :-1], gk[:, -1], gv[:, 0], dtype)
+    def col(name):
+        arr = np.asarray(flows[name], np.int64)
+        return arr if full else arr[base]
+
+    key_cols = np.stack([col(c) for c in key_names], axis=1)
+    key_mat, values, times, mask = _group_and_pad(
+        key_cols, col("flowEndSeconds"), col("throughput"), op, dtype)
     keys = _decode_keys(flows, key_names, key_mat)
     return SeriesBatch(key_names, keys, values, times, mask, spec.agg_type)
 
@@ -233,11 +260,15 @@ def _build_pod_series(flows: ColumnarBatch, spec: TadQuerySpec,
             code = flows.dicts[ns_col].lookup(spec.pod_namespace)
             m &= np.asarray(flows[ns_col]) == (
                 -1 if code is None else code)
-        sub = flows.filter(m)
-        keys = np.stack([np.asarray(sub[ns_col], np.int64),
-                         np.asarray(sub[id_col], np.int64)], axis=1)
-        parts.append((keys, np.asarray(sub["flowEndSeconds"], np.int64),
-                      np.asarray(sub["throughput"], np.int64), direction))
+        full = bool(m.all())
+
+        def col(name, m=m, full=full):
+            arr = np.asarray(flows[name], np.int64)
+            return arr if full else arr[m]
+
+        keys = np.stack([col(ns_col), col(id_col)], axis=1)
+        parts.append((keys, col("flowEndSeconds"), col("throughput"),
+                      direction))
 
     id_name = "podName" if by_name else "podLabels"
     key_names = ("podNamespace", id_name, "direction")
@@ -249,10 +280,8 @@ def _build_pod_series(flows: ColumnarBatch, spec: TadQuerySpec,
     all_t = np.concatenate([t for _, t, _, _ in parts])
     all_v = np.concatenate([v for _, _, v, _ in parts])
 
-    stage1 = np.concatenate([all_keys, all_t[:, None]], axis=1)
-    gk, gv = group_reduce(stage1, all_v[:, None], "sum")
-    key_mat, values, times, mask = _pack_and_pad(
-        gk[:, :-1], gk[:, -1], gv[:, 0], dtype)
+    key_mat, values, times, mask = _group_and_pad(
+        all_keys, all_t, all_v, "sum", dtype)
 
     ns_dict = flows.dicts["destinationPodNamespace"]
     id_dict = flows.dicts[

@@ -36,6 +36,7 @@ _KIND_CODE = {"int": 0, "float": 1, "string": 2}
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 _SRC = os.path.join(_REPO_ROOT, "native", "flowblock.cc")
+_SRC_SERIES = os.path.join(_REPO_ROOT, "native", "seriesbuild.cc")
 _BUILD_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "_build")
 _SO = os.path.join(_BUILD_DIR, "flowblock.so")
@@ -61,38 +62,72 @@ def _load_library() -> Optional[ctypes.CDLL]:
             return _lib
         try:
             os.makedirs(_BUILD_DIR, exist_ok=True)
+            src_mtime = max(os.path.getmtime(_SRC),
+                            os.path.getmtime(_SRC_SERIES))
             if (not os.path.exists(_SO)
-                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
-                subprocess.run(
-                    ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-                     "-o", _SO, _SRC],
-                    check=True, capture_output=True, text=True)
-            lib = ctypes.CDLL(_SO)
-            lib.fb_new.restype = ctypes.c_void_p
-            lib.fb_new.argtypes = [ctypes.c_int32,
-                                   ctypes.POINTER(ctypes.c_int32)]
-            lib.fb_seed.argtypes = [ctypes.c_void_p, ctypes.c_int32,
-                                    ctypes.c_char_p, ctypes.c_int64]
-            lib.fb_decode.restype = ctypes.c_int64
-            lib.fb_decode.argtypes = [
-                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
-                ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
-                ctypes.POINTER(ctypes.c_int32)]
-            lib.fb_decode_block.restype = ctypes.c_int64
-            lib.fb_decode_block.argtypes = lib.fb_decode.argtypes
-            lib.fb_dict_size.restype = ctypes.c_int64
-            lib.fb_dict_size.argtypes = [ctypes.c_void_p,
-                                         ctypes.c_int32]
-            lib.fb_dict_get.restype = ctypes.c_void_p
-            lib.fb_dict_get.argtypes = [
-                ctypes.c_void_p, ctypes.c_int32, ctypes.c_int64,
-                ctypes.POINTER(ctypes.c_int64)]
-            lib.fb_free.argtypes = [ctypes.c_void_p]
+                    or os.path.getmtime(_SO) < src_mtime):
+                _compile()
+            try:
+                lib = _bind(ctypes.CDLL(_SO))
+            except AttributeError:
+                # Stale .so from an older source set (mtime-preserving
+                # cache restore): missing symbols → rebuild once.
+                os.remove(_SO)
+                _compile()
+                lib = _bind(ctypes.CDLL(_SO))
             _lib = lib
-        except (OSError, subprocess.CalledProcessError) as e:
+        except (OSError, subprocess.CalledProcessError,
+                AttributeError) as e:
             detail = getattr(e, "stderr", "") or str(e)
             _build_error = f"native ingest unavailable: {detail}"
         return _lib
+
+
+def _compile() -> None:
+    subprocess.run(
+        ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+         "-o", _SO, _SRC, _SRC_SERIES],
+        check=True, capture_output=True, text=True)
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.fb_new.restype = ctypes.c_void_p
+    lib.fb_new.argtypes = [ctypes.c_int32,
+                           ctypes.POINTER(ctypes.c_int32)]
+    lib.fb_seed.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                            ctypes.c_char_p, ctypes.c_int64]
+    lib.fb_decode.restype = ctypes.c_int64
+    lib.fb_decode.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int32)]
+    lib.fb_decode_block.restype = ctypes.c_int64
+    lib.fb_decode_block.argtypes = lib.fb_decode.argtypes
+    lib.fb_dict_size.restype = ctypes.c_int64
+    lib.fb_dict_size.argtypes = [ctypes.c_void_p,
+                                 ctypes.c_int32]
+    lib.fb_dict_get.restype = ctypes.c_void_p
+    lib.fb_dict_get.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64)]
+    lib.fb_free.argtypes = [ctypes.c_void_p]
+    lib.sb_build.restype = ctypes.c_void_p
+    lib.sb_build.argtypes = [
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int32]
+    lib.sb_dims.argtypes = [ctypes.c_void_p,
+                            ctypes.POINTER(ctypes.c_int64),
+                            ctypes.POINTER(ctypes.c_int64)]
+    lib.sb_fill.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_uint8)]
+    lib.sb_free.argtypes = [ctypes.c_void_p]
+    return lib
 
 
 def native_available() -> bool:
@@ -471,3 +506,49 @@ def encode_tsv(batch: ColumnarBatch, schema=FLOW_SCHEMA) -> bytes:
     for i in range(len(batch)):
         rows.append("\t".join(str(c[i]) for c in columns))
     return ("\n".join(rows) + "\n").encode()
+
+
+def build_padded_series(keys: np.ndarray, times: np.ndarray,
+                        values: np.ndarray, op: str,
+                        dtype=np.float64):
+    """Native tensorize: group rows by [n, k] int64 key tuples into
+    padded per-series time arrays (native/seriesbuild.cc).
+
+    Returns (key_mat [S,k] int64, values [S,T] dtype, times [S,T] int64,
+    mask [S,T] bool) with series in lexicographic key order and points
+    in time order — bit-identical to the numpy group_reduce +
+    _pack_and_pad path in analytics/series.py. Duplicate (key, time)
+    rows reduce with `op` ("max" or "sum"). Returns None when the
+    native library is unavailable (caller falls back to numpy).
+    """
+    lib = _load_library()
+    if lib is None:
+        return None
+    keys = np.ascontiguousarray(keys, np.int64)
+    times = np.ascontiguousarray(times, np.int64)
+    values = np.ascontiguousarray(values, np.int64)
+    n, k = keys.shape
+    handle = lib.sb_build(
+        keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        times.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        values.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n, k, 0 if op == "max" else 1)
+    try:
+        S = ctypes.c_int64()
+        T = ctypes.c_int64()
+        lib.sb_dims(handle, ctypes.byref(S), ctypes.byref(T))
+        s, t = S.value, T.value
+        key_mat = np.empty((s, k), np.int64)
+        vals = np.empty((s, t), np.float64)
+        ts = np.empty((s, t), np.int64)
+        mask = np.empty((s, t), np.uint8)
+        lib.sb_fill(
+            handle,
+            key_mat.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            vals.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            ts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            mask.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    finally:
+        lib.sb_free(handle)
+    return key_mat, vals.astype(dtype, copy=False), ts, \
+        mask.astype(bool)
