@@ -90,11 +90,8 @@ def read_distinct_flows(flows: ColumnarBatch,
     # Materialize only the 9 queried columns (same narrow-column rule
     # as the series tensorize: filtering all 52 costs more than the
     # distinct kernel it feeds).
-    full = bool(mask.all())
-    keys = np.stack(
-        [np.asarray(flows[c], np.int64) if full
-         else np.asarray(flows[c], np.int64)[mask]
-         for c in FLOW_TABLE_COLUMNS], axis=1)
+    col = flows.column_selector(mask)
+    keys = np.stack([col(c) for c in FLOW_TABLE_COLUMNS], axis=1)
     uniq, _counts = device_distinct(keys)
 
     rows: List[Dict[str, object]] = []

@@ -223,11 +223,7 @@ def build_series(flows: ColumnarBatch, spec: TadQuerySpec,
     # Materialize only the columns this query touches (masking all 52
     # through ColumnarBatch.filter costs more than the grouping itself
     # on the tensorize hot path).
-    full = bool(base.all())
-
-    def col(name):
-        arr = np.asarray(flows[name], np.int64)
-        return arr if full else arr[base]
+    col = flows.column_selector(base)
 
     key_cols = np.stack([col(c) for c in key_names], axis=1)
     key_mat, values, times, mask = _group_and_pad(
@@ -260,11 +256,7 @@ def _build_pod_series(flows: ColumnarBatch, spec: TadQuerySpec,
             code = flows.dicts[ns_col].lookup(spec.pod_namespace)
             m &= np.asarray(flows[ns_col]) == (
                 -1 if code is None else code)
-        full = bool(m.all())
-
-        def col(name, m=m, full=full):
-            arr = np.asarray(flows[name], np.int64)
-            return arr if full else arr[m]
+        col = flows.column_selector(m)
 
         keys = np.stack([col(ns_col), col(id_col)], axis=1)
         parts.append((keys, col("flowEndSeconds"), col("throughput"),

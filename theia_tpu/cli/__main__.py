@@ -30,6 +30,14 @@ import urllib.request
 import uuid
 from typing import Dict, Optional
 
+from ..utils import (
+    AGG_FLOWS,
+    POLICY_TYPES,
+    TAD_ALGOS,
+    get_manager_addr,
+    validate_k8s_quantity,
+)
+
 DEFAULT_ADDR = "http://127.0.0.1:11347"
 GROUP = "/apis/intelligence.theia.antrea.io/v1alpha1"
 POLL_INTERVAL = 5.0
@@ -111,6 +119,18 @@ def _print_job_table(items) -> None:
                          progress, st.get("errorMsg", "")))
 
 
+def _sizing_body(args) -> Dict[str, object]:
+    """Resource-sizing spec fields (reference CRD spec,
+    pkg/apis/crd/v1alpha1/types.go)."""
+    return {
+        "executorInstances": args.executor_instances,
+        "driverCoreRequest": args.driver_core_request,
+        "driverMemory": args.driver_memory,
+        "executorCoreRequest": args.executor_core_request,
+        "executorMemory": args.executor_memory,
+    }
+
+
 # -- policy-recommendation ----------------------------------------------
 
 def npr_run(args) -> None:
@@ -126,7 +146,7 @@ def npr_run(args) -> None:
         if args.ns_allow_list else None,
         "excludeLabels": args.exclude_labels,
         "toServices": args.to_services,
-        "executorInstances": args.executor_instances,
+        **_sizing_body(args),
     }
     body = {k: v for k, v in body.items() if v is not None}
     _request(args.manager_addr, "POST", f"{GROUP}/{NPR_RESOURCE}", body)
@@ -200,7 +220,7 @@ def tad_run(args) -> None:
         "externalIp": args.external_ip or None,
         "servicePortName": args.svc_port_name or None,
         "clusterUUID": args.cluster_uuid or None,
-        "executorInstances": args.executor_instances,
+        **_sizing_body(args),
     }
     body = {k: v for k, v in body.items() if v is not None}
     _request(args.manager_addr, "POST", f"{GROUP}/{TAD_RESOURCE}", body)
@@ -331,12 +351,42 @@ def version(args) -> None:
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="theia", description="theia-tpu command line tool")
-    p.add_argument("--manager-addr", default=DEFAULT_ADDR,
-                   help="theia-manager API address")
+    p.add_argument("--manager-addr", default=get_manager_addr(DEFAULT_ADDR),
+                   help="theia-manager API address (env "
+                        "THEIA_MANAGER_ADDR overrides the default)")
     p.add_argument("--ca-cert", default="",
                    help="CA certificate for a TLS manager (the "
                         "published theia-ca.crt)")
+    p.add_argument("-v", "--verbosity", type=int, default=0,
+                   help="log verbosity (klog-style)")
     sub = p.add_subparsers(dest="command", required=True)
+
+    def quantity(flag):
+        def parse(value):
+            try:
+                return validate_k8s_quantity(value, flag)
+            except ValueError as e:
+                raise argparse.ArgumentTypeError(str(e))
+        return parse
+
+    def sizing_flags(run):
+        """Job resource sizing (reference CRD spec fields validated at
+        pkg/controller/networkpolicyrecommendation/controller.go:586-608;
+        defaults from pkg/theia/commands/policy_recommendation_run.go:
+        324-352 — 1 executor, 200m CPU, 512M memory)."""
+        run.add_argument("--executor-instances",
+                         dest="executor_instances", type=int, default=1)
+        run.add_argument("--driver-core-request",
+                         dest="driver_core_request", default="200m",
+                         type=quantity("driver-core-request"))
+        run.add_argument("--driver-memory", dest="driver_memory",
+                         default="512M", type=quantity("driver-memory"))
+        run.add_argument("--executor-core-request",
+                         dest="executor_core_request", default="200m",
+                         type=quantity("executor-core-request"))
+        run.add_argument("--executor-memory", dest="executor_memory",
+                         default="512M",
+                         type=quantity("executor-memory"))
 
     def add_job_commands(group, run_fn, status_fn, retrieve_fn, list_fn,
                          delete_fn, run_flags):
@@ -365,8 +415,7 @@ def build_parser() -> argparse.ArgumentParser:
         run.add_argument("-l", "--limit", type=int, default=0)
         run.add_argument("-p", "--policy-type", dest="policy_type",
                          default="anp-deny-applied",
-                         choices=["anp-deny-applied", "anp-deny-all",
-                                  "k8s-np"])
+                         choices=list(POLICY_TYPES))
         run.add_argument("-s", "--start-time", dest="start_time",
                          default="")
         run.add_argument("-e", "--end-time", dest="end_time", default="")
@@ -376,8 +425,7 @@ def build_parser() -> argparse.ArgumentParser:
                          type=lambda v: v != "false", default=True)
         run.add_argument("--to-services", dest="to_services",
                          type=lambda v: v != "false", default=True)
-        run.add_argument("--executor-instances",
-                         dest="executor_instances", type=int, default=1)
+        sizing_flags(run)
 
     add_job_commands(npr, npr_run, npr_status, npr_retrieve, npr_list,
                      npr_delete, npr_flags)
@@ -386,14 +434,14 @@ def build_parser() -> argparse.ArgumentParser:
 
     def tad_flags(run):
         run.add_argument("-a", "--algo", required=True,
-                         choices=["EWMA", "ARIMA", "DBSCAN"])
+                         choices=list(TAD_ALGOS))
         run.add_argument("-s", "--start-time", dest="start_time",
                          default="")
         run.add_argument("-e", "--end-time", dest="end_time", default="")
         run.add_argument("-n", "--ns-ignore-list", dest="ns_ignore_list",
                          default="")
         run.add_argument("--agg-flow", dest="agg_flow", default="",
-                         choices=["", "pod", "external", "svc"])
+                         choices=list(AGG_FLOWS))
         run.add_argument("--pod-label", dest="pod_label", default="")
         run.add_argument("--pod-name", dest="pod_name", default="")
         run.add_argument("--pod-namespace", dest="pod_namespace",
@@ -403,8 +451,7 @@ def build_parser() -> argparse.ArgumentParser:
                          default="")
         run.add_argument("--cluster-uuid", dest="cluster_uuid",
                          default="")
-        run.add_argument("--executor-instances",
-                         dest="executor_instances", type=int, default=1)
+        sizing_flags(run)
 
     add_job_commands(tad, tad_run, tad_status, tad_retrieve, tad_list,
                      tad_delete, tad_flags)
@@ -431,6 +478,8 @@ def main(argv=None) -> None:
     global _CA_CERT
     args = build_parser().parse_args(argv)
     _CA_CERT = getattr(args, "ca_cert", "") or ""
+    from ..utils import set_verbosity
+    set_verbosity(getattr(args, "verbosity", 0))
     try:
         args.fn(args)
     except BrokenPipeError:

@@ -39,7 +39,19 @@ _SRC = os.path.join(_REPO_ROOT, "native", "flowblock.cc")
 _SRC_SERIES = os.path.join(_REPO_ROOT, "native", "seriesbuild.cc")
 _BUILD_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "_build")
-_SO = os.path.join(_BUILD_DIR, "flowblock.so")
+
+
+def _so_path() -> str:
+    """Content-hashed artifact name: a stale .so can never be picked up
+    (and dlopen caches by pathname, so rebuilding under the SAME name
+    would return the already-loaded stale handle — the name must
+    change with the sources)."""
+    import hashlib
+    h = hashlib.sha1()
+    for src in (_SRC, _SRC_SERIES):
+        with open(src, "rb") as f:
+            h.update(f.read())
+    return os.path.join(_BUILD_DIR, f"flowblock-{h.hexdigest()[:12]}.so")
 
 _lib_lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -62,20 +74,10 @@ def _load_library() -> Optional[ctypes.CDLL]:
             return _lib
         try:
             os.makedirs(_BUILD_DIR, exist_ok=True)
-            src_mtime = max(os.path.getmtime(_SRC),
-                            os.path.getmtime(_SRC_SERIES))
-            if (not os.path.exists(_SO)
-                    or os.path.getmtime(_SO) < src_mtime):
-                _compile()
-            try:
-                lib = _bind(ctypes.CDLL(_SO))
-            except AttributeError:
-                # Stale .so from an older source set (mtime-preserving
-                # cache restore): missing symbols → rebuild once.
-                os.remove(_SO)
-                _compile()
-                lib = _bind(ctypes.CDLL(_SO))
-            _lib = lib
+            so = _so_path()
+            if not os.path.exists(so):
+                _compile(so)
+            _lib = _bind(ctypes.CDLL(so))
         except (OSError, subprocess.CalledProcessError,
                 AttributeError) as e:
             detail = getattr(e, "stderr", "") or str(e)
@@ -83,11 +85,13 @@ def _load_library() -> Optional[ctypes.CDLL]:
         return _lib
 
 
-def _compile() -> None:
+def _compile(so: str) -> None:
+    tmp = so + ".tmp"
     subprocess.run(
         ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-         "-o", _SO, _SRC, _SRC_SERIES],
+         "-o", tmp, _SRC, _SRC_SERIES],
         check=True, capture_output=True, text=True)
+    os.replace(tmp, so)  # atomic: concurrent processes see whole files
 
 
 def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:

@@ -23,6 +23,12 @@ import threading
 
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(prog="theia_tpu.manager")
+    p.add_argument("--config", default=None,
+                   help="YAML config file (reference "
+                        "cmd/theia-manager/options.go): apiServer."
+                        "{apiPort,selfSignedCert,tlsCertDir}; flags win")
+    p.add_argument("-v", "--verbosity", type=int, default=0,
+                   help="log verbosity (klog-style)")
     p.add_argument("--db", default=None, help="FlowDatabase .npz path")
     p.add_argument("--port", type=int, default=None)
     p.add_argument("--address", default="127.0.0.1",
@@ -46,11 +52,33 @@ def main(argv=None) -> None:
     args = p.parse_args(argv)
 
     from ..store import FlowDatabase, ShardedFlowDatabase
+    from ..utils import get_logger, set_verbosity
     from .api import API_PORT, TheiaManagerServer
 
+    set_verbosity(args.verbosity)
+    log = get_logger("theia-manager")
+
+    if args.config:
+        import yaml
+        with open(args.config) as f:
+            conf = yaml.safe_load(f) or {}
+        api_conf = conf.get("apiServer") or {}
+        if args.port is None and "apiPort" in api_conf:
+            args.port = int(api_conf["apiPort"])
+        # TLS is on whenever the config carries TLS settings;
+        # selfSignedCert=false means "use operator-provided certs from
+        # the cert dir", not "plaintext" (reference options.go).
+        if args.tls_cert_dir is None and (
+                api_conf.get("selfSignedCert")
+                or api_conf.get("tlsCertDir")):
+            args.tls_cert_dir = str(
+                api_conf.get("tlsCertDir", "/var/run/theia/tls"))
+        log.v(1).info("loaded config from %s", args.config)
+
+    from ..utils import env_int
     ttl = args.ttl_seconds
-    if ttl is None and os.environ.get("THEIA_TTL_SECONDS"):
-        ttl = int(os.environ["THEIA_TTL_SECONDS"])
+    if ttl is None:
+        ttl = env_int("THEIA_TTL_SECONDS", 0) or None
 
     if args.shards > 1:
         if args.db and os.path.exists(args.db):

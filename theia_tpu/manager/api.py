@@ -39,6 +39,9 @@ from .jobs import (
     JobRecord,
 )
 from .stats import StatsProvider
+from ..utils import dump_logs, get_logger
+
+logger = get_logger("apiserver")
 
 API_PORT = 11347
 
@@ -116,6 +119,11 @@ class SupportBundleManager:
                     [record_to_api(r, self.controller)
                      for r in self.controller.list()], indent=2,
                     default=str))
+                # Recent manager logs — the reference's ManagerDumper
+                # copies log files out of the component pods
+                # (pkg/support/dump.go:55-66); here the in-process ring
+                # buffer is the log source.
+                add("logs/theia-manager.log", dump_logs())
             with self._lock:
                 self._data = buf.getvalue()
                 self.status = "collected"
@@ -148,6 +156,7 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
     quiet = True
 
     def log_message(self, fmt, *args):  # noqa: N802
+        logger.v(2).info("%s %s", self.address_string(), fmt % args)
         if not self.quiet:
             super().log_message(fmt, *args)
 

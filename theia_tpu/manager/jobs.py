@@ -30,6 +30,9 @@ import numpy as np
 from ..analytics import TadQuerySpec, run_npr, run_tad
 from ..runner.progress import NPR_STAGES, TAD_STAGES, JobProgress
 from ..store import FlowDatabase
+from ..utils import get_logger, parse_job_name, validate_policy_type
+
+logger = get_logger("jobs")
 
 STATE_NEW = "NEW"
 STATE_SCHEDULED = "SCHEDULED"
@@ -50,13 +53,7 @@ class DuplicateJobError(Exception):
 def job_id_from_name(kind: str, name: str) -> str:
     """pr-<uuid> / tad-<uuid> → <uuid> (reference ParseRecommendationName
     / ParseADAlgorithmName, pkg/util/utils.go)."""
-    prefix = _NAME_PREFIX[kind]
-    if not name.startswith(prefix):
-        raise ValueError(
-            f"invalid {kind} job name {name!r}: expected prefix {prefix}")
-    suffix = name[len(prefix):]
-    uuid.UUID(suffix)  # raises on malformed id
-    return suffix
+    return parse_job_name(name, _NAME_PREFIX[kind])
 
 
 @dataclasses.dataclass
@@ -212,6 +209,7 @@ class JobController:
     def _run(self, record: JobRecord) -> None:
         record.state = STATE_RUNNING
         record.start_time = time.time()
+        logger.v(1).info("job %s started", record.name)
         try:
             if record.kind == KIND_TAD:
                 record.progress = JobProgress(record.job_id, TAD_STAGES)
@@ -237,13 +235,10 @@ class JobController:
             else:
                 record.progress = JobProgress(record.job_id, NPR_STAGES)
                 spec = record.spec
-                policy_type = str(spec.get("policyType",
-                                           "anp-deny-applied"))
+                policy_type = validate_policy_type(
+                    str(spec.get("policyType", "anp-deny-applied")))
                 option = {"anp-deny-applied": 1, "anp-deny-all": 2,
-                          "k8s-np": 3}.get(policy_type)
-                if option is None:
-                    raise ValueError(
-                        f"invalid policyType {policy_type!r}")
+                          "k8s-np": 3}[policy_type]
                 run_npr(
                     self.db,
                     recommendation_type=str(spec.get("jobType",
@@ -258,12 +253,15 @@ class JobController:
                     recommendation_id=record.job_id,
                     progress=record.progress)
             record.state = STATE_COMPLETED
+            logger.v(1).info("job %s completed in %.2fs", record.name,
+                             time.time() - record.start_time)
         except Exception as e:   # job failure → FAILED CR status
             record.state = STATE_FAILED
             record.error_msg = f"{type(e).__name__}: {e}"
             if record.progress:
                 record.progress.fail(record.error_msg)
-            traceback.print_exc()
+            logger.error("job %s failed: %s\n%s", record.name,
+                         record.error_msg, traceback.format_exc())
         finally:
             record.end_time = time.time()
             # If the CR was deleted while the job ran, its result rows

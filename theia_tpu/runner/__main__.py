@@ -30,6 +30,8 @@ import json
 import sys
 from typing import Optional
 
+from ..utils import AGG_FLOWS, TAD_ALGOS
+
 TIME_FORMAT = "%Y-%m-%d %H:%M:%S"
 
 
@@ -60,7 +62,7 @@ def build_parser() -> argparse.ArgumentParser:
     tad.add_argument("--db", required=True,
                      help="FlowDatabase .npz path")
     tad.add_argument("-a", "--algo", required=True,
-                     choices=["EWMA", "ARIMA", "DBSCAN"])
+                     choices=list(TAD_ALGOS))
     tad.add_argument("-s", "--start_time", default="",
                      help=f"'{TIME_FORMAT}' UTC")
     tad.add_argument("-e", "--end_time", default="")
@@ -68,7 +70,7 @@ def build_parser() -> argparse.ArgumentParser:
     tad.add_argument("-n", "--ns-ignore-list", "--ns_ignore_list",
                      dest="ns_ignore_list", default="")
     tad.add_argument("-f", "--agg-flow", dest="agg_flow", default="",
-                     choices=["", "pod", "external", "svc"])
+                     choices=list(AGG_FLOWS))
     tad.add_argument("-l", "--pod-label", dest="pod_label", default="")
     tad.add_argument("-N", "--pod-name", dest="pod_name", default="")
     tad.add_argument("-P", "--pod-namespace", dest="pod_namespace",

@@ -139,6 +139,20 @@ class ColumnarBatch:
                              {n: d for n, d in self.dicts.items()
                               if n in names})
 
+    def column_selector(self, mask: np.ndarray, dtype=np.int64):
+        """Narrow-column masked materializer: `col(name)` returns one
+        column under `mask`, skipping the copy when the mask is all-true.
+        The query paths use this instead of `filter(mask)` because
+        masking all 52 columns costs more than the kernel the handful of
+        surviving columns feed."""
+        full = bool(mask.all())
+
+        def col(name: str) -> np.ndarray:
+            arr = np.asarray(self.columns[name], dtype)
+            return arr if full else arr[mask]
+
+        return col
+
     @staticmethod
     def concat(batches: Sequence["ColumnarBatch"]) -> "ColumnarBatch":
         """Concatenate batches. String columns encoded with *different*
