@@ -1,0 +1,180 @@
+"""Tests for abnormal traffic-drop detection.
+
+Golden semantics from the reference's Snowflake backend:
+query shape snowflake/cmd/dropDetection.go:36-175 (drop/reject filter,
+victim-endpoint attribution, per-day counts) and UDTF scoring
+snowflake/udfs/udfs/drop_detection/drop_detection_udf.py:43-56
+(mean +/- 3*stddev_samp, >= 3 observations per partition).
+"""
+
+import numpy as np
+import pytest
+
+from theia_tpu.analytics import run_drop_detection
+from theia_tpu.store import FlowDatabase
+
+DAY = 86400
+
+
+def _drop_row(day, src=("ns-a", "pod-a", "10.0.0.1"),
+              dst=("ns-b", "pod-b", "10.0.0.2"),
+              ingress_action=0, egress_action=0):
+    return {
+        "flowStartSeconds": day * DAY + 100,
+        "flowEndSeconds": day * DAY + 110,
+        "sourcePodNamespace": src[0], "sourcePodName": src[1],
+        "sourceIP": src[2],
+        "destinationPodNamespace": dst[0], "destinationPodName": dst[1],
+        "destinationIP": dst[2],
+        "ingressNetworkPolicyRuleAction": ingress_action,
+        "egressNetworkPolicyRuleAction": egress_action,
+        "timeInserted": day * DAY + 120,
+    }
+
+
+def _seed(db, counts, ingress=True, dst=("ns-b", "pod-b", "10.0.0.2")):
+    """counts[d] dropped flows on day d, all for one victim endpoint."""
+    rows = []
+    for day, n in enumerate(counts):
+        for _ in range(n):
+            rows.append(_drop_row(
+                day, dst=dst,
+                ingress_action=2 if ingress else 0,
+                egress_action=0 if ingress else 3))
+    db.insert_flow_rows(rows)
+
+
+def test_spike_detected_ingress():
+    db = FlowDatabase()
+    # 14 quiet days + one extreme spike. Note the UDTF's statistics
+    # include the outlier itself, so a single spike among n samples can
+    # only exceed 3*stddev_samp when (n-1)/sqrt(n) > 3, i.e. n >= 12.
+    counts = [1] * 14 + [500]
+    _seed(db, counts, ingress=True)
+    dd_id = run_drop_detection(db, detection_id=None)
+    rows = db.dropdetection.scan().to_rows()
+    assert len(rows) == 1
+    r = rows[0]
+    assert r["id"] == dd_id
+    assert r["endpoint"] == "ns-b/pod-b"     # victim = destination
+    assert r["direction"] == "ingress"
+    assert r["anomalyDropNumber"] == 500
+    assert r["anomalyDropDate"] == 14 * DAY
+    # Stats match numpy mean / sample std over the 15 daily counts.
+    assert r["avgDrop"] == pytest.approx(np.mean(counts), rel=1e-6)
+    assert r["stdevDrop"] == pytest.approx(
+        np.std(counts, ddof=1), rel=1e-5)
+
+
+def test_egress_attribution_and_ip_fallback():
+    db = FlowDatabase()
+    # Egress-dropped flows from a podless source → endpoint is the IP.
+    counts = [1] * 14 + [300]
+    rows = []
+    for day, n in enumerate(counts):
+        for _ in range(n):
+            rows.append(_drop_row(day, src=("", "", "172.16.0.9"),
+                                  egress_action=2))
+    db.insert_flow_rows(rows)
+    run_drop_detection(db)
+    out = db.dropdetection.scan().to_rows()
+    assert len(out) == 1
+    assert out[0]["endpoint"] == "172.16.0.9"
+    assert out[0]["direction"] == "egress"
+
+
+def test_min_observations_skips_short_partitions():
+    db = FlowDatabase()
+    _seed(db, [1, 50], ingress=True)   # only 2 observed days
+    run_drop_detection(db)
+    assert len(db.dropdetection.scan()) == 0
+
+
+def test_allowed_flows_ignored():
+    db = FlowDatabase()
+    rows = [_drop_row(d, ingress_action=1)  # 1 = Allow
+            for d in range(5) for _ in range(10)]
+    db.insert_flow_rows(rows)
+    run_drop_detection(db)
+    assert len(db.dropdetection.scan()) == 0
+
+
+def test_cluster_uuid_filter():
+    db = FlowDatabase()
+    counts = [1] * 14 + [300]
+    rows = []
+    for day, n in enumerate(counts):
+        for _ in range(n):
+            r = _drop_row(day, ingress_action=2)
+            r["clusterUUID"] = "cluster-east"
+            rows.append(r)
+    db.insert_flow_rows(rows)
+    run_drop_detection(db, cluster_uuid="cluster-west")
+    assert len(db.dropdetection.scan()) == 0
+    run_drop_detection(db, cluster_uuid="cluster-east")
+    assert len(db.dropdetection.scan()) == 1
+
+
+def test_time_window():
+    db = FlowDatabase()
+    counts = [1] * 14 + [300]
+    _seed(db, counts, ingress=True)
+    # Window that excludes the spike day → no anomalies.
+    run_drop_detection(db, end_time=14 * DAY)
+    assert len(db.dropdetection.scan()) == 0
+
+
+def test_job_type_validation():
+    db = FlowDatabase()
+    with pytest.raises(ValueError):
+        run_drop_detection(db, job_type="periodical")
+
+
+def test_save_load_roundtrip_with_dropdetection(tmp_path):
+    db = FlowDatabase()
+    _seed(db, [1] * 14 + [300])
+    run_drop_detection(db, detection_id="11111111-2222-3333-4444-555555555555")
+    path = str(tmp_path / "db.npz")
+    db.save(path)
+    db2 = FlowDatabase.load(path)
+    rows = db2.dropdetection.scan().to_rows()
+    assert len(rows) == 1
+    assert rows[0]["endpoint"] == "ns-b/pod-b"
+
+
+def test_migration_v4_up_down(tmp_path):
+    from theia_tpu.store.migration import (
+        CURRENT_SCHEMA_VERSION, migrate, payload_version)
+    assert CURRENT_SCHEMA_VERSION == 4
+    payload = {"flows/trusted": np.zeros(3, np.int32),
+               "flows/egressName": np.zeros(3, np.int32),
+               "flows/__dict__/egressName": np.asarray([""], object)}
+    assert payload_version(payload) == 3
+    migrate(payload)
+    assert payload_version(payload) == 4
+    assert "dropdetection/id" in payload
+    migrate(payload, target=3)
+    assert not any(k.startswith("dropdetection/") for k in payload)
+
+
+def test_manager_dd_lifecycle():
+    """POST trafficdropdetections → COMPLETED → stats attach → delete
+    GCs result rows (controller state machine parity)."""
+    from theia_tpu.manager.api import record_to_api
+    from theia_tpu.manager.jobs import JobController
+
+    db = FlowDatabase()
+    _seed(db, [1] * 14 + [300])
+    controller = JobController(db, workers=1)
+    try:
+        record = controller.create("dd", {"jobType": "initial"})
+        assert controller.wait_all()
+        assert record.state == "COMPLETED"
+        doc = record_to_api(record, controller, with_result=True)
+        assert doc["kind"] == "TrafficDropDetection"
+        assert len(doc["stats"]) == 1
+        assert doc["stats"][0]["endpoint"] == "ns-b/pod-b"
+        controller.delete(record.name)
+        assert len(db.dropdetection.scan()) == 0
+    finally:
+        controller.shutdown()

@@ -25,4 +25,4 @@ Subpackages:
   utils     — shared helpers
 """
 
-__version__ = "0.2.0"
+__version__ = "0.3.0"

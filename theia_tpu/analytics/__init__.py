@@ -1,5 +1,7 @@
-"""Analytics jobs: throughput anomaly detection + policy recommendation."""
+"""Analytics jobs: throughput anomaly detection, policy recommendation,
+and abnormal traffic-drop detection."""
 
+from .drop_detection import run_drop_detection
 from .npr import (NAMESPACE_ALLOW_LIST, read_distinct_flows, run_npr)
 from .series import SeriesBatch, TadQuerySpec, build_series
 from .streaming import StreamingDetector, stream_update
@@ -10,4 +12,5 @@ __all__ = [
     "ALGORITHMS", "detect_anomalies", "run_tad", "score_series",
     "NAMESPACE_ALLOW_LIST", "read_distinct_flows", "run_npr",
     "StreamingDetector", "stream_update",
+    "run_drop_detection",
 ]

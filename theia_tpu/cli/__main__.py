@@ -45,6 +45,7 @@ POLL_TIMEOUT = 3600.0
 
 NPR_RESOURCE = "networkpolicyrecommendations"
 TAD_RESOURCE = "throughputanomalydetectors"
+DD_RESOURCE = "trafficdropdetections"
 
 TIME_FORMAT = "%Y-%m-%d %H:%M:%S"
 
@@ -292,6 +293,74 @@ def tad_delete(args) -> None:
           f"name: {args.name}")
 
 
+# -- drop-detection (theia-sf drop-detection equivalent) ----------------
+
+def _print_dd_stats(stats) -> None:
+    if not stats:
+        print("No abnormal traffic drops found")
+        return
+    _print_table(stats, [
+        "id", "endpoint", "direction", "avgDrop", "stdevDrop",
+        "anomalyDropDate", "anomalyDropNumber"])
+
+
+def dd_run(args) -> None:
+    name = "dd-" + str(uuid.uuid4())
+    body = {
+        "metadata": {"name": name},
+        "jobType": args.type,
+        "startInterval": _parse_time_arg(args.start_time, "start-time"),
+        "endInterval": _parse_time_arg(args.end_time, "end-time"),
+        "clusterUUID": args.cluster_uuid or None,
+    }
+    body = {k: v for k, v in body.items() if v is not None}
+    _request(args.manager_addr, "POST", f"{GROUP}/{DD_RESOURCE}", body)
+    print(f"Successfully started traffic drop detection job with "
+          f"name: {name}")
+    if args.wait:
+        doc = _wait_for_job(args.manager_addr, DD_RESOURCE, name)
+        st = doc.get("status") or {}
+        if st.get("state") == "FAILED":
+            raise APIError(
+                f"error: job failed: {st.get('errorMsg', '')}")
+        _print_dd_stats(doc.get("stats", []))
+
+
+def dd_status(args) -> None:
+    doc = _request(args.manager_addr, "GET",
+                   f"{GROUP}/{DD_RESOURCE}/{args.name}")
+    st = doc.get("status") or {}
+    print(f"Status of this traffic drop detection job is "
+          f"{st.get('state', '')}")
+    if st.get("state") == "RUNNING":
+        print(f"Completed stages: {st.get('completedStages', 0)}/"
+              f"{st.get('totalStages', 0)}")
+
+
+def dd_retrieve(args) -> None:
+    doc = _request(args.manager_addr, "GET",
+                   f"{GROUP}/{DD_RESOURCE}/{args.name}")
+    stats = doc.get("stats", [])
+    if args.file:
+        with open(args.file, "w") as f:
+            json.dump(stats, f, indent=2)
+        print(f"Drop anomalies written to {args.file}")
+    else:
+        _print_dd_stats(stats)
+
+
+def dd_list(args) -> None:
+    doc = _request(args.manager_addr, "GET", f"{GROUP}/{DD_RESOURCE}")
+    _print_job_table(doc.get("items", []))
+
+
+def dd_delete(args) -> None:
+    _request(args.manager_addr, "DELETE",
+             f"{GROUP}/{DD_RESOURCE}/{args.name}")
+    print(f"Successfully deleted traffic drop detection job with "
+          f"name: {args.name}")
+
+
 # -- clickhouse / supportbundle / version -------------------------------
 
 def clickhouse_status(args) -> None:
@@ -455,6 +524,21 @@ def build_parser() -> argparse.ArgumentParser:
 
     add_job_commands(tad, tad_run, tad_status, tad_retrieve, tad_list,
                      tad_delete, tad_flags)
+
+    dd = sub.add_parser("drop-detection", aliases=["dd"],
+                        help="abnormal traffic-drop detection")
+
+    def dd_flags(run):
+        run.add_argument("-t", "--type", default="initial",
+                         choices=["initial"])
+        run.add_argument("-s", "--start-time", dest="start_time",
+                         default="")
+        run.add_argument("-e", "--end-time", dest="end_time", default="")
+        run.add_argument("--cluster-uuid", dest="cluster_uuid",
+                         default="")
+
+    add_job_commands(dd, dd_run, dd_status, dd_retrieve, dd_list,
+                     dd_delete, dd_flags)
 
     ch = sub.add_parser("clickhouse")
     chsub = ch.add_subparsers(dest="action", required=True)

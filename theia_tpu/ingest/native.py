@@ -86,12 +86,19 @@ def _load_library() -> Optional[ctypes.CDLL]:
 
 
 def _compile(so: str) -> None:
-    tmp = so + ".tmp"
-    subprocess.run(
-        ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-         "-o", tmp, _SRC, _SRC_SERIES],
-        check=True, capture_output=True, text=True)
-    os.replace(tmp, so)  # atomic: concurrent processes see whole files
+    # Per-process scratch name, atomically published: a concurrent
+    # builder racing on a shared tmp path could otherwise publish a
+    # half-written .so under the content-hashed (never-rebuilt) name.
+    tmp = f"{so}.{os.getpid()}.tmp"
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+             "-o", tmp, _SRC, _SRC_SERIES],
+            check=True, capture_output=True, text=True)
+        os.replace(tmp, so)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
 
 
 def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:

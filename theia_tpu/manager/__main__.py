@@ -67,10 +67,10 @@ def main(argv=None) -> None:
             args.port = int(api_conf["apiPort"])
         # TLS is on whenever the config carries TLS settings;
         # selfSignedCert=false means "use operator-provided certs from
-        # the cert dir", not "plaintext" (reference options.go).
+        # the cert dir", not "plaintext" (reference options.go) — so
+        # key presence, not truthiness, decides.
         if args.tls_cert_dir is None and (
-                api_conf.get("selfSignedCert")
-                or api_conf.get("tlsCertDir")):
+                "selfSignedCert" in api_conf or "tlsCertDir" in api_conf):
             args.tls_cert_dir = str(
                 api_conf.get("tlsCertDir", "/var/run/theia/tls"))
         log.v(1).info("loaded config from %s", args.config)

@@ -31,6 +31,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 
 from .jobs import (
+    KIND_DD,
     KIND_NPR,
     KIND_TAD,
     STATE_COMPLETED,
@@ -52,10 +53,12 @@ GROUP_SYSTEM = "/apis/system.theia.antrea.io/v1alpha1"
 _RESOURCE_KIND = {
     "networkpolicyrecommendations": KIND_NPR,
     "throughputanomalydetectors": KIND_TAD,
+    "trafficdropdetections": KIND_DD,
 }
 _KIND_NAMES = {
     KIND_NPR: "NetworkPolicyRecommendation",
     KIND_TAD: "ThroughputAnomalyDetector",
+    KIND_DD: "TrafficDropDetection",
 }
 
 
@@ -72,6 +75,8 @@ def record_to_api(record: JobRecord, controller: JobController,
         if record.kind == KIND_NPR:
             doc["status"]["recommendationOutcome"] = (  # type: ignore
                 controller.recommendation_outcome(record.name))
+        elif record.kind == KIND_DD:
+            doc["stats"] = controller.drop_detection_stats(record.name)
         else:
             doc["stats"] = controller.tad_stats(record.name)
     return doc

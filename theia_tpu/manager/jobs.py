@@ -27,8 +27,10 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from ..analytics import TadQuerySpec, run_npr, run_tad
-from ..runner.progress import NPR_STAGES, TAD_STAGES, JobProgress
+from ..analytics import (TadQuerySpec, run_drop_detection, run_npr,
+                         run_tad)
+from ..runner.progress import (DD_STAGES, NPR_STAGES, TAD_STAGES,
+                               JobProgress)
 from ..store import FlowDatabase
 from ..utils import get_logger, parse_job_name, validate_policy_type
 
@@ -42,8 +44,9 @@ STATE_FAILED = "FAILED"
 
 KIND_NPR = "npr"
 KIND_TAD = "tad"
+KIND_DD = "dd"
 
-_NAME_PREFIX = {KIND_NPR: "pr-", KIND_TAD: "tad-"}
+_NAME_PREFIX = {KIND_NPR: "pr-", KIND_TAD: "tad-", KIND_DD: "dd-"}
 
 
 class DuplicateJobError(Exception):
@@ -148,7 +151,8 @@ class JobController:
         with self._lock:
             live = {r.job_id for r in self._records.values()}
         removed = 0
-        for table in (self.db.recommendations, self.db.tadetector):
+        for table in (self.db.recommendations, self.db.tadetector,
+                      self.db.dropdetection):
             data = table.scan()
             if not len(data):
                 continue
@@ -160,8 +164,9 @@ class JobController:
         return removed
 
     def _delete_results(self, kind: str, job_id: str) -> None:
-        table = (self.db.recommendations if kind == KIND_NPR
-                 else self.db.tadetector)
+        table = {KIND_NPR: self.db.recommendations,
+                 KIND_TAD: self.db.tadetector,
+                 KIND_DD: self.db.dropdetection}[kind]
         data = table.scan()
         if len(data):
             table.delete_where(data.strings("id") == job_id)
@@ -183,6 +188,16 @@ class JobController:
         (reference getTADetectorResult, rest.go:249-310)."""
         job_id = job_id_from_name(KIND_TAD, name)
         data = self.db.tadetector.scan()
+        if not len(data):
+            return []
+        rows = data.filter(data.strings("id") == job_id)
+        return [{k: str(v) for k, v in row.items()}
+                for row in rows.to_rows()]
+
+    def drop_detection_stats(self, name: str) -> List[Dict[str, str]]:
+        """dropdetection rows for a completed drop-detection job."""
+        job_id = job_id_from_name(KIND_DD, name)
+        data = self.db.dropdetection.scan()
         if not len(data):
             return []
         rows = data.filter(data.strings("id") == job_id)
@@ -231,6 +246,17 @@ class JobController:
                         cluster_uuid=str(
                             spec.get("clusterUUID", "") or "")),
                     tad_id=record.job_id,
+                    progress=record.progress)
+            elif record.kind == KIND_DD:
+                record.progress = JobProgress(record.job_id, DD_STAGES)
+                spec = record.spec
+                run_drop_detection(
+                    self.db,
+                    job_type=str(spec.get("jobType", "initial")),
+                    detection_id=record.job_id,
+                    start_time=spec.get("startInterval") or None,
+                    end_time=spec.get("endInterval") or None,
+                    cluster_uuid=str(spec.get("clusterUUID", "") or ""),
                     progress=record.progress)
             else:
                 record.progress = JobProgress(record.job_id, NPR_STAGES)

@@ -1,0 +1,40 @@
+"""Traffic-drop anomaly scoring kernel.
+
+Re-provides the per-partition statistics of the reference's Snowflake
+drop-detection UDTF (snowflake/udfs/udfs/drop_detection/
+drop_detection_udf.py:43-56): for each (endpoint, direction) partition's
+daily drop-count series, anomaly iff the count falls outside
+mean ± 3·stddev_samp, and partitions with fewer than 3 observations are
+skipped.
+
+TPU-first: partitions are rows of a padded [S, D] matrix (S partitions ×
+D dates, mask marks real observations); the whole fleet scores in one
+fused jitted step instead of the reference's per-partition pandas pass.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .masked import masked_count, masked_mean, masked_stddev_samp
+
+MIN_OBSERVATIONS = 3
+SIGMA = 3.0
+
+
+@jax.jit
+def drop_scores(counts: jnp.ndarray, mask: jnp.ndarray):
+    """counts [S, D] float, mask [S, D] bool → (anomaly [S, D] bool,
+    mean [S], stddev [S]). Rows with < MIN_OBSERVATIONS valid entries
+    produce no anomalies (UDTF end_partition early return)."""
+    counts = counts.astype(jnp.float32)
+    mean = masked_mean(counts, mask)
+    std = masked_stddev_samp(counts, mask)
+    n = masked_count(mask)
+    upper = mean + SIGMA * std
+    lower = mean - SIGMA * std
+    anomaly = (counts > upper[:, None]) | (counts < lower[:, None])
+    anomaly &= mask
+    anomaly &= (n >= MIN_OBSERVATIONS)[:, None]
+    return anomaly, mean, std

@@ -98,6 +98,19 @@ def build_parser() -> argparse.ArgumentParser:
     npr.add_argument("--rm_labels", default="true")
     npr.add_argument("--to_services", default="true")
     npr.add_argument("--progress-file", default=None)
+
+    dd = sub.add_parser("dropdetection",
+                        help="abnormal traffic-drop detection "
+                             "(theia-sf drop-detection equivalent)")
+    dd.add_argument("--db", required=True)
+    dd.add_argument("-t", "--type", dest="job_type", default="initial",
+                    choices=["initial"])
+    dd.add_argument("-s", "--start_time", default="")
+    dd.add_argument("-e", "--end_time", default="")
+    dd.add_argument("-c", "--cluster-uuid", dest="cluster_uuid",
+                    default="")
+    dd.add_argument("-i", "--id", default=None)
+    dd.add_argument("--progress-file", default=None)
     return p
 
 
@@ -164,12 +177,39 @@ def run_npr_job(args) -> str:
     return job_id
 
 
+def run_dd_job(args) -> str:
+    from ..analytics import run_drop_detection
+    from ..store import FlowDatabase
+    from .progress import DD_STAGES, JobProgress
+
+    progress = JobProgress(args.id or "dd", DD_STAGES,
+                           path=args.progress_file)
+    try:
+        db = FlowDatabase.load(args.db)
+        job_id = run_drop_detection(
+            db,
+            job_type=args.job_type,
+            detection_id=args.id,
+            start_time=parse_time(args.start_time),
+            end_time=parse_time(args.end_time),
+            cluster_uuid=args.cluster_uuid,
+            progress=progress,
+        )
+        db.save(args.db)
+    except BaseException as e:
+        progress.fail(str(e))
+        raise
+    return job_id
+
+
 def main(argv=None) -> None:
     args = build_parser().parse_args(argv)
     if args.job == "tad":
         job_id = run_tad_job(args)
-    else:
+    elif args.job == "npr":
         job_id = run_npr_job(args)
+    else:
+        job_id = run_dd_job(args)
     print(json.dumps({"id": job_id, "state": "COMPLETED"}))
 
 

@@ -90,3 +90,4 @@ class JobProgress:
 
 TAD_STAGES = ["read", "tensorize", "score", "write"]
 NPR_STAGES = ["read", "recommend", "write"]
+DD_STAGES = ["read", "tensorize", "score", "write"]

@@ -7,5 +7,6 @@ from .flow_schema import (  # noqa: F401
     NUMERIC_COLUMNS,
     TADETECTOR_SCHEMA,
     RECOMMENDATIONS_SCHEMA,
+    DROPDETECTION_SCHEMA,
 )
 from .columnar import StringDictionary, ColumnarBatch  # noqa: F401

@@ -179,3 +179,19 @@ RECOMMENDATIONS_SCHEMA: tuple = _cols(
     ("policy", K.STRING),
     ("kind", K.STRING),
 )
+
+# Result table for abnormal traffic-drop detection — the capability the
+# reference ships only on its Snowflake backend (UDTF result row at
+# snowflake/udfs/udfs/drop_detection/drop_detection_udf.py:6-19; query
+# shape snowflake/cmd/dropDetection.go:36-175).
+DROPDETECTION_SCHEMA: tuple = _cols(
+    ("jobType", K.STRING),
+    ("id", K.STRING),
+    ("timeCreated", K.DATETIME),
+    ("endpoint", K.STRING),
+    ("direction", K.STRING),
+    ("avgDrop", K.F64),
+    ("stdevDrop", K.F64),
+    ("anomalyDropDate", K.DATETIME),
+    ("anomalyDropNumber", K.U64),
+)

@@ -25,6 +25,7 @@ from typing import Dict, List, Mapping, Optional, Sequence
 import numpy as np
 
 from ..schema import (
+    DROPDETECTION_SCHEMA,
     FLOW_SCHEMA,
     RECOMMENDATIONS_SCHEMA,
     TADETECTOR_SCHEMA,
@@ -250,6 +251,7 @@ class FlowDatabase:
         self.tadetector = Table("tadetector", TADETECTOR_SCHEMA)
         self.recommendations = Table("recommendations",
                                      RECOMMENDATIONS_SCHEMA)
+        self.dropdetection = Table("dropdetection", DROPDETECTION_SCHEMA)
         self.views: Dict[str, ViewTable] = {
             name: ViewTable(name, spec, self.flows.dicts)
             for name, spec in MATERIALIZED_VIEWS.items()}
@@ -309,7 +311,8 @@ class FlowDatabase:
         stamped with the current schema version (store/migration.py)."""
         from .migration import CURRENT_SCHEMA_VERSION, force
         payload: Dict[str, np.ndarray] = {}
-        for table in (self.flows, self.tadetector, self.recommendations):
+        for table in (self.flows, self.tadetector, self.recommendations,
+                      self.dropdetection):
             data = table.scan()
             for col in table.schema:
                 payload[f"{table.name}/{col.name}"] = data[col.name]
@@ -335,7 +338,8 @@ class FlowDatabase:
         with np.load(path, allow_pickle=True) as z:
             payload = {k: z[k] for k in z.files}
         migrate(payload)
-        for table in (db.flows, db.tadetector, db.recommendations):
+        for table in (db.flows, db.tadetector, db.recommendations,
+                      db.dropdetection):
             cols: Dict[str, np.ndarray] = {}
             for name, d in table.dicts.items():
                 key = f"{table.name}/__dict__/{name}"

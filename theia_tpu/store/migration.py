@@ -12,7 +12,8 @@ here migrators are column transforms over the persisted .npz payload.
 Schema history (mirrors the reference's column evolution):
   v1 — flows without `trusted`           (pre policy-feedback)
   v2 — + `trusted` UInt8                 (subsequent-NPR support)
-  v3 — + `egressName`, `egressIP`        (egress observability; current)
+  v3 — + `egressName`, `egressIP`        (egress observability)
+  v4 — + `dropdetection` result table    (traffic-drop detection; current)
 """
 
 from __future__ import annotations
@@ -22,7 +23,7 @@ from typing import Callable, Dict, List
 
 import numpy as np
 
-CURRENT_SCHEMA_VERSION = 3
+CURRENT_SCHEMA_VERSION = 4
 VERSION_KEY = "__schema_version__"
 
 # framework version → schema version (reference VERSION_MAP,
@@ -31,6 +32,7 @@ VERSION_MAP = {
     "0.1.0": 1,
     "0.1.1": 2,
     "0.2.0": 3,
+    "0.3.0": 4,
 }
 
 Payload = Dict[str, np.ndarray]
@@ -77,7 +79,34 @@ MIGRATIONS: List[Migration] = [
                       _add_string(p, "egressIP")) and None,
         down=lambda p: (_drop(p, "egressName"),
                         _drop(p, "egressIP")) and None),
+    Migration(
+        version=4, name="add_dropdetection_table",
+        up=lambda p: _add_dropdetection(p),
+        down=lambda p: _drop_table(p, "dropdetection")),
 ]
+
+
+def _add_dropdetection(payload: Payload) -> None:
+    """Empty `dropdetection` result table (columns per
+    DROPDETECTION_SCHEMA; string columns get an ''-seeded dict, the
+    same empty-table layout FlowDatabase.save emits)."""
+    for name, dtype in (("jobType", None), ("id", None),
+                        ("timeCreated", np.int64), ("endpoint", None),
+                        ("direction", None), ("avgDrop", np.float64),
+                        ("stdevDrop", np.float64),
+                        ("anomalyDropDate", np.int64),
+                        ("anomalyDropNumber", np.uint64)):
+        if dtype is None:  # string column
+            payload[f"dropdetection/{name}"] = np.zeros(0, np.int32)
+            payload[f"dropdetection/__dict__/{name}"] = np.asarray(
+                [""], dtype=object)
+        else:
+            payload[f"dropdetection/{name}"] = np.zeros(0, dtype)
+
+
+def _drop_table(payload: Payload, table: str) -> None:
+    for key in [k for k in payload if k.startswith(f"{table}/")]:
+        payload.pop(key)
 
 
 def payload_version(payload: Payload) -> int:
