@@ -223,15 +223,20 @@ def test_block_with_out_of_range_codes_rejected(block_wire):
     # string plane instead: flip bytes across the whole planes section)
     from theia_tpu.schema import FLOW_SCHEMA as _S
     n_rows = len(batch)
+
+    def width(c):
+        # TFB2 plane widths: int32 codes / host-width numerics
+        return 4 if c.is_string else np.dtype(c.host_dtype).itemsize
+
     # planes section starts at len(good) - total plane bytes
-    plane_bytes = sum((4 if c.is_string else 8) * n_rows for c in _S)
+    plane_bytes = sum(width(c) * n_rows for c in _S)
     start = len(good) - plane_bytes
     # find offset of the first string column's plane
     off = start
     for c in _S:
         if c.is_string:
             break
-        off += 8 * n_rows
+        off += width(c) * n_rows
     bad = bytearray(good)
     bad[off:off + 4] = (2 ** 31 - 1).to_bytes(4, "little")
     for force_python in (False, True):
@@ -302,3 +307,51 @@ def test_block_delta_with_intra_delta_duplicate_rejected(block_wire):
             dec.decode_block(bad)
         # nothing from the rejected delta may have been minted
         assert dec.dicts["sourceIP"].lookup("brand-new") is None
+
+
+def test_block_v1_backward_compat(block_wire):
+    """TFB1 blocks (8-byte-widened numeric planes) still decode —
+    mixed-version producers during a rolling upgrade."""
+    from theia_tpu.ingest.native import BLOCK_MAGIC_V1
+    from theia_tpu.schema import FLOW_SCHEMA as _S
+    batch, enc, _ = block_wire
+
+    # Craft a v1 block from a fresh encoder (full dictionary delta).
+    enc1 = BlockEncoder()
+    codes = {}
+    parts = [BLOCK_MAGIC_V1, np.int64(len(batch)).tobytes(),
+             np.int32(len(_S)).tobytes()]
+    for col in _S:
+        if not col.is_string:
+            continue
+        d = enc1.dicts[col.name]
+        codes[col.name] = d.encode(
+            list(batch.strings(col.name))).astype(np.int32)
+        base, delta = 1, d.entries_since(1)
+        parts.append(np.asarray([base, len(delta)], np.int32).tobytes())
+        for s in delta:
+            raw = s.encode()
+            parts.append(np.int32(len(raw)).tobytes())
+            parts.append(raw)
+    for col in _S:
+        if col.is_string:
+            parts.append(codes[col.name].tobytes())
+        else:
+            arr = np.asarray(batch[col.name])
+            if arr.dtype == np.float64:
+                parts.append(arr.tobytes())
+            else:
+                parts.append(arr.astype(np.int64).tobytes())
+    payload_v1 = b"".join(parts)
+
+    for force_python in (False, True):
+        if not force_python and not native_available():
+            continue
+        dec = TsvDecoder(force_python=force_python)
+        out = dec.decode_block(payload_v1)
+        assert len(out) == len(batch)
+        np.testing.assert_array_equal(out.strings("sourceIP"),
+                                      batch.strings("sourceIP"))
+        np.testing.assert_array_equal(
+            np.asarray(out["throughput"]),
+            np.asarray(batch["throughput"]))

@@ -114,6 +114,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_int32)]
     lib.fb_decode_block.restype = ctypes.c_int64
     lib.fb_decode_block.argtypes = lib.fb_decode.argtypes
+    lib.fb_decode_block2.restype = ctypes.c_int64
+    lib.fb_decode_block2.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_void_p)]
     lib.fb_dict_size.restype = ctypes.c_int64
     lib.fb_dict_size.argtypes = [ctypes.c_void_p,
                                  ctypes.c_int32]
@@ -162,6 +167,13 @@ class TsvDecoder:
                 self.dicts.setdefault(col.name, StringDictionary())
         self._numeric_cols = [c for c in schema if not c.is_string]
         self._string_cols = [c for c in schema if c.is_string]
+        # Per-column plane width/dtype for the TFB2 wire format: string
+        # codes are int32, numerics travel at their host width.
+        self._col_dtype = [np.dtype(np.int32) if c.is_string
+                           else np.dtype(c.host_dtype) for c in schema]
+        self._col_width = [d.itemsize for d in self._col_dtype]
+        self._widths_arr = (ctypes.c_int32 * len(schema))(
+            *self._col_width)
         self._lib = None if force_python else _load_library()
         self._handle = None
         # How many python-dictionary entries the native side has seen,
@@ -270,18 +282,22 @@ class TsvDecoder:
         how the reference's FlowAggregator actually inserts
         (clickhouse-go `tcp://…:9000`, pkg/util/clickhouse/clickhouse.go:125).
         """
-        if len(payload) < 16 or payload[:4] != BLOCK_MAGIC:
+        if len(payload) < 16 or payload[:4] not in (BLOCK_MAGIC,
+                                                    BLOCK_MAGIC_V1):
             raise ValueError("not a flow block payload")
+        v2 = payload[:4] == BLOCK_MAGIC
         n_rows = int(np.frombuffer(payload, np.int64, 1, 4)[0])
         # Output allocation is sized from the header, so sanity-bound it
         # against what the payload could possibly carry before trusting
         # a (possibly corrupt/hostile) row count.
-        row_bytes = (8 * len(self._numeric_cols)
-                     + 4 * len(self._string_cols))
+        row_bytes = sum(self._col_width) if v2 else (
+            8 * len(self._numeric_cols) + 4 * len(self._string_cols))
         if n_rows < 0 or n_rows * row_bytes > len(payload):
             raise ValueError(
                 f"flow block claims {n_rows} rows but carries only "
                 f"{len(payload)} bytes")
+        if self._handle is not None and v2:
+            return self._decode_block2_native(payload, n_rows)
         if self._handle is not None:
             self._push_python_dicts()
             ints = np.empty((len(self._numeric_cols), max(n_rows, 1)),
@@ -312,10 +328,50 @@ class TsvDecoder:
                 raise ValueError(f"malformed flow block ({n})")
             self._sync_dicts()
             return self._planes_to_batch(ints, codes, int(n))
-        return self._decode_block_python(payload, n_rows)
+        return self._decode_block_python(payload, n_rows, v2)
 
-    def _decode_block_python(self, payload: bytes,
-                             n_rows: int) -> ColumnarBatch:
+    _BLOCK_ERRORS = {
+        -2: "dictionary desync: block's delta base does not match the "
+            "decoder's dictionary (blocks must be decoded in stream "
+            "order)",
+        -4: "flow block carries string codes outside its dictionary",
+        -5: "dictionary desync: block's delta repeats an existing or "
+            "intra-delta entry",
+    }
+
+    def _decode_block2_native(self, payload: bytes,
+                              n_rows: int) -> ColumnarBatch:
+        """TFB2 fast path: planes land directly in the final per-column
+        arrays (no widening buffer, no re-narrowing pass). All columns
+        live in ONE allocation (8-byte-aligned slices) — one np.empty
+        instead of 52 per block."""
+        self._push_python_dicts()
+        n = max(n_rows, 1)
+        offsets = []
+        total = 0
+        for w in self._col_width:
+            total = (total + 7) & ~7      # keep every slice 8B-aligned
+            offsets.append(total)
+            total += n * w
+        buf = np.empty(total, np.uint8)
+        arrays = [np.frombuffer(buf.data, dt, n, off)
+                  for dt, off in zip(self._col_dtype, offsets)]
+        base = buf.ctypes.data
+        out = (ctypes.c_void_p * len(arrays))(
+            *[base + off for off in offsets])
+        n = self._lib.fb_decode_block2(
+            self._handle, payload, len(payload), max(n_rows, 1),
+            self._widths_arr, out)
+        if n < 0:
+            raise ValueError(self._BLOCK_ERRORS.get(
+                n, f"malformed flow block ({n})"))
+        self._sync_dicts()
+        return ColumnarBatch(
+            {col.name: arr[:n] for col, arr in zip(self.schema, arrays)},
+            self.dicts)
+
+    def _decode_block_python(self, payload: bytes, n_rows: int,
+                             v2: bool = True) -> ColumnarBatch:
         """Mirrors the native decoder's discipline: the whole block is
         parsed and validated into locals first; the shared dictionaries
         are only touched once nothing can fail."""
@@ -365,8 +421,12 @@ class TsvDecoder:
             deltas[col.name] = entries
             limits[col.name] = int(base) + len(entries)
         cols: Dict[str, np.ndarray] = {}
-        for col in self.schema:
-            width = 4 if col.is_string else 8
+        for i, col in enumerate(self.schema):
+            if v2:
+                width, dtype = self._col_width[i], self._col_dtype[i]
+            else:
+                width = 4 if col.is_string else 8
+                dtype = np.int32 if col.is_string else np.int64
             if off + n_rows * width > len(payload):
                 raise ValueError("malformed flow block (truncated)")
             if col.is_string:
@@ -378,6 +438,9 @@ class TsvDecoder:
                         "flow block carries string codes outside its "
                         "dictionary")
                 cols[col.name] = codes
+            elif v2:
+                cols[col.name] = np.frombuffer(payload, dtype, n_rows,
+                                               off).copy()
             else:
                 raw = np.frombuffer(payload, np.int64, n_rows, off)
                 if col.kind == ColumnKind.F64:
@@ -440,7 +503,10 @@ class TsvDecoder:
         return ColumnarBatch(cols, self.dicts)
 
 
-BLOCK_MAGIC = b"TFB1"
+# Current wire format: TFB2 (native-width column planes). TFB1 blocks
+# (8-byte-widened numeric planes) are still accepted on decode.
+BLOCK_MAGIC = b"TFB2"
+BLOCK_MAGIC_V1 = b"TFB1"
 
 
 class BlockEncoder:
@@ -495,13 +561,11 @@ class BlockEncoder:
         for col in self.schema:
             if col.is_string:
                 parts.append(np.ascontiguousarray(
-                    code_cols[col.name]).tobytes())
-            elif col.kind == ColumnKind.F64:
-                parts.append(np.asarray(batch[col.name],
-                                        np.float64).tobytes())
+                    code_cols[col.name], np.int32).tobytes())
             else:
-                parts.append(np.asarray(batch[col.name],
-                                        np.int64).tobytes())
+                # TFB2: numerics travel at their host width.
+                parts.append(np.ascontiguousarray(
+                    batch[col.name], col.host_dtype).tobytes())
         return b"".join(parts)
 
 

@@ -183,26 +183,23 @@ class JobController:
         rows = data.filter(data.strings("id") == job_id)
         return "---\n".join(rows.strings("policy"))
 
-    def tad_stats(self, name: str) -> List[Dict[str, str]]:
-        """tadetector rows for a TAD job as string-typed stat entries
+    def _result_stats(self, kind: str, table,
+                      name: str) -> List[Dict[str, str]]:
+        """Result rows for a job as string-typed stat entries
         (reference getTADetectorResult, rest.go:249-310)."""
-        job_id = job_id_from_name(KIND_TAD, name)
-        data = self.db.tadetector.scan()
+        job_id = job_id_from_name(kind, name)
+        data = table.scan()
         if not len(data):
             return []
         rows = data.filter(data.strings("id") == job_id)
         return [{k: str(v) for k, v in row.items()}
                 for row in rows.to_rows()]
 
+    def tad_stats(self, name: str) -> List[Dict[str, str]]:
+        return self._result_stats(KIND_TAD, self.db.tadetector, name)
+
     def drop_detection_stats(self, name: str) -> List[Dict[str, str]]:
-        """dropdetection rows for a completed drop-detection job."""
-        job_id = job_id_from_name(KIND_DD, name)
-        data = self.db.dropdetection.scan()
-        if not len(data):
-            return []
-        rows = data.filter(data.strings("id") == job_id)
-        return [{k: str(v) for k, v in row.items()}
-                for row in rows.to_rows()]
+        return self._result_stats(KIND_DD, self.db.dropdetection, name)
 
     # -- workers ---------------------------------------------------------
 

@@ -87,21 +87,19 @@ MIGRATIONS: List[Migration] = [
 
 
 def _add_dropdetection(payload: Payload) -> None:
-    """Empty `dropdetection` result table (columns per
-    DROPDETECTION_SCHEMA; string columns get an ''-seeded dict, the
-    same empty-table layout FlowDatabase.save emits)."""
-    for name, dtype in (("jobType", None), ("id", None),
-                        ("timeCreated", np.int64), ("endpoint", None),
-                        ("direction", None), ("avgDrop", np.float64),
-                        ("stdevDrop", np.float64),
-                        ("anomalyDropDate", np.int64),
-                        ("anomalyDropNumber", np.uint64)):
-        if dtype is None:  # string column
-            payload[f"dropdetection/{name}"] = np.zeros(0, np.int32)
-            payload[f"dropdetection/__dict__/{name}"] = np.asarray(
+    """Empty `dropdetection` result table (columns straight from
+    DROPDETECTION_SCHEMA so the migrator can't drift from the live
+    schema; string columns get an ''-seeded dict, the same empty-table
+    layout FlowDatabase.save emits)."""
+    from ..schema import DROPDETECTION_SCHEMA
+    for col in DROPDETECTION_SCHEMA:
+        if col.is_string:
+            payload[f"dropdetection/{col.name}"] = np.zeros(0, np.int32)
+            payload[f"dropdetection/__dict__/{col.name}"] = np.asarray(
                 [""], dtype=object)
         else:
-            payload[f"dropdetection/{name}"] = np.zeros(0, dtype)
+            payload[f"dropdetection/{col.name}"] = np.zeros(
+                0, col.host_dtype)
 
 
 def _drop_table(payload: Payload, table: str) -> None:
