@@ -93,6 +93,68 @@ inline bool parse_int(const char* b, const char* e, int64_t* out) {
   return true;
 }
 
+// Walk + validate the dictionary-delta section shared by both block
+// formats; advances *pp past the deltas without mutating any
+// dictionary. Delta entries must be novel (not already in the
+// dictionary, and not repeated within the delta) — a duplicate would
+// grow `strings` without a matching to_code entry and desync the code
+// sequence for good. Fills new_sizes (indexed by string slot) with the
+// post-delta dictionary sizes. Returns 0, or -1 malformed / -2 desync /
+// -5 duplicate entry.
+int32_t validate_deltas(const Decoder* d, const char** pp,
+                        const char* end,
+                        std::vector<int32_t>* new_sizes) {
+  const char* p = *pp;
+  auto need = [&](int64_t n) { return end - p >= n; };
+  const int32_t n_cols = static_cast<int32_t>(d->kinds.size());
+  for (int32_t c = 0; c < n_cols; ++c) {
+    if (d->kinds[c] != kString) continue;
+    const Dict& dict = d->dicts[d->slot[c]];
+    int32_t base, count;
+    if (!need(8)) return -1;
+    memcpy(&base, p, 4); p += 4;
+    memcpy(&count, p, 4); p += 4;
+    if (count < 0) return -1;
+    if (base != static_cast<int32_t>(dict.strings.size())) return -2;
+    std::unordered_map<std::string_view, int32_t> fresh;
+    for (int32_t i = 0; i < count; ++i) {
+      int32_t len;
+      if (!need(4)) return -1;
+      memcpy(&len, p, 4); p += 4;
+      if (len < 0 || !need(len)) return -1;
+      std::string_view sv(p, static_cast<size_t>(len));
+      if (dict.to_code.find(sv) != dict.to_code.end()) return -5;
+      if (!fresh.emplace(sv, i).second) return -5;
+      p += len;
+    }
+    (*new_sizes)[d->slot[c]] = base + count;
+  }
+  *pp = p;
+  return 0;
+}
+
+// Append the delta entries (assumes validate_deltas passed over the
+// same bytes); advances *pp past the deltas.
+void commit_deltas(Decoder* d, const char** pp) {
+  const char* p = *pp;
+  const int32_t n_cols = static_cast<int32_t>(d->kinds.size());
+  for (int32_t c = 0; c < n_cols; ++c) {
+    if (d->kinds[c] != kString) continue;
+    Dict& dict = d->dicts[d->slot[c]];
+    int32_t base, count;
+    memcpy(&base, p, 4); p += 4;
+    memcpy(&count, p, 4); p += 4;
+    for (int32_t i = 0; i < count; ++i) {
+      int32_t len;
+      memcpy(&len, p, 4); p += 4;
+      dict.add(std::string_view(p, static_cast<size_t>(len)),
+               base + i);
+      p += len;
+    }
+  }
+  *pp = p;
+}
+
 }  // namespace
 
 extern "C" {
@@ -215,32 +277,7 @@ int64_t fb_decode_block(void* h, const char* buf, int64_t nbytes,
   // -- validation pass: walk the whole block without mutating anything.
   const char* delta_start = p;
   std::vector<int32_t> new_sizes(d->dicts.size());
-  for (int32_t c = 0; c < n_cols; ++c) {
-    if (d->kinds[c] != kString) continue;
-    const Dict& dict = d->dicts[d->slot[c]];
-    int32_t base, count;
-    if (!need(8)) return -1;
-    memcpy(&base, p, 4); p += 4;
-    memcpy(&count, p, 4); p += 4;
-    if (count < 0) return -1;
-    if (base != static_cast<int32_t>(dict.strings.size())) return -2;
-    // Delta entries must be novel (not already in the dictionary, and
-    // not repeated within the delta) — a duplicate would grow
-    // `strings` without a matching to_code entry and desync the code
-    // sequence for good.
-    std::unordered_map<std::string_view, int32_t> fresh;
-    for (int32_t i = 0; i < count; ++i) {
-      int32_t len;
-      if (!need(4)) return -1;
-      memcpy(&len, p, 4); p += 4;
-      if (len < 0 || !need(len)) return -1;
-      std::string_view sv(p, static_cast<size_t>(len));
-      if (dict.to_code.find(sv) != dict.to_code.end()) return -5;
-      if (!fresh.emplace(sv, i).second) return -5;
-      p += len;
-    }
-    new_sizes[d->slot[c]] = base + count;
-  }
+  if (int32_t err = validate_deltas(d, &p, end, &new_sizes)) return err;
   const char* planes_start = p;
   for (int32_t c = 0; c < n_cols; ++c) {
     const int64_t width = (d->kinds[c] == kString) ? 4 : 8;
@@ -259,20 +296,7 @@ int64_t fb_decode_block(void* h, const char* buf, int64_t nbytes,
 
   // -- commit pass: append dictionary deltas, bulk-copy planes.
   p = delta_start;
-  for (int32_t c = 0; c < n_cols; ++c) {
-    if (d->kinds[c] != kString) continue;
-    Dict& dict = d->dicts[d->slot[c]];
-    int32_t base, count;
-    memcpy(&base, p, 4); p += 4;
-    memcpy(&count, p, 4); p += 4;
-    for (int32_t i = 0; i < count; ++i) {
-      int32_t len;
-      memcpy(&len, p, 4); p += 4;
-      dict.add(std::string_view(p, static_cast<size_t>(len)),
-               base + i);
-      p += len;
-    }
-  }
+  commit_deltas(d, &p);
   p = planes_start;
   for (int32_t c = 0; c < n_cols; ++c) {
     const int32_t slot = d->slot[c];
@@ -323,28 +347,7 @@ int64_t fb_decode_block2(void* h, const char* buf, int64_t nbytes,
   // -- dictionary-delta validation pass (no mutation).
   const char* delta_start = p;
   std::vector<int32_t> new_sizes(d->dicts.size());
-  for (int32_t c = 0; c < n_cols; ++c) {
-    if (d->kinds[c] != kString) continue;
-    const Dict& dict = d->dicts[d->slot[c]];
-    int32_t base, count;
-    if (!need(8)) return -1;
-    memcpy(&base, p, 4); p += 4;
-    memcpy(&count, p, 4); p += 4;
-    if (count < 0) return -1;
-    if (base != static_cast<int32_t>(dict.strings.size())) return -2;
-    std::unordered_map<std::string_view, int32_t> fresh;
-    for (int32_t i = 0; i < count; ++i) {
-      int32_t len;
-      if (!need(4)) return -1;
-      memcpy(&len, p, 4); p += 4;
-      if (len < 0 || !need(len)) return -1;
-      std::string_view sv(p, static_cast<size_t>(len));
-      if (dict.to_code.find(sv) != dict.to_code.end()) return -5;
-      if (!fresh.emplace(sv, i).second) return -5;
-      p += len;
-    }
-    new_sizes[d->slot[c]] = base + count;
-  }
+  if (int32_t err = validate_deltas(d, &p, end, &new_sizes)) return err;
 
   // -- plane copy + code validation (dicts still untouched).
   for (int32_t c = 0; c < n_cols; ++c) {
@@ -369,20 +372,7 @@ int64_t fb_decode_block2(void* h, const char* buf, int64_t nbytes,
 
   // -- commit: append dictionary deltas.
   p = delta_start;
-  for (int32_t c = 0; c < n_cols; ++c) {
-    if (d->kinds[c] != kString) continue;
-    Dict& dict = d->dicts[d->slot[c]];
-    int32_t base, count;
-    memcpy(&base, p, 4); p += 4;
-    memcpy(&count, p, 4); p += 4;
-    for (int32_t i = 0; i < count; ++i) {
-      int32_t len;
-      memcpy(&len, p, 4); p += 4;
-      dict.add(std::string_view(p, static_cast<size_t>(len)),
-               base + i);
-      p += len;
-    }
-  }
+  commit_deltas(d, &p);
   return n_rows;
 }
 

@@ -176,3 +176,19 @@ def test_cli_retrieve_and_delete(server, capsys):
     assert "deleted" in capsys.readouterr().out
     with pytest.raises(SystemExit):
         cli_main(addr + ["tad", "status", name])
+
+
+def test_device_info_stats(server):
+    """deviceInfo: accelerator inventory over the stats API (opt-in
+    component; absent from the bare-resource GET so store-stat polls
+    never initialize a JAX backend)."""
+    doc = _get(server,
+               "/apis/stats.theia.antrea.io/v1alpha1/clickhouse/"
+               "deviceInfo")
+    infos = doc["deviceInfos"]
+    assert infos, "at least one device expected"
+    assert infos[0]["platform"]          # cpu under tests
+    assert "deviceId" in infos[0]
+    bare = _get(server,
+                "/apis/stats.theia.antrea.io/v1alpha1/clickhouse")
+    assert "deviceInfos" not in bare

@@ -367,7 +367,8 @@ def clickhouse_status(args) -> None:
     components = [c for c, on in (
         ("diskInfo", args.diskInfo), ("tableInfo", args.tableInfo),
         ("insertRate", args.insertRate),
-        ("stackTraces", args.stackTraces)) if on]
+        ("stackTraces", args.stackTraces),
+        ("deviceInfo", args.deviceInfo)) if on]
     if not components:
         components = ["diskInfo", "tableInfo", "insertRate"]
     for comp in components:
@@ -376,7 +377,8 @@ def clickhouse_status(args) -> None:
                        f"clickhouse/{comp}")
         key = {"diskInfo": "diskInfos", "tableInfo": "tableInfos",
                "insertRate": "insertRates",
-               "stackTraces": "stackTraces"}[comp]
+               "stackTraces": "stackTraces",
+               "deviceInfo": "deviceInfos"}[comp]
         rows = doc.get(key, [])
         print(f"== {comp} ==")
         if rows:
@@ -547,6 +549,9 @@ def build_parser() -> argparse.ArgumentParser:
     status.add_argument("--tableInfo", action="store_true")
     status.add_argument("--insertRate", action="store_true")
     status.add_argument("--stackTraces", action="store_true")
+    status.add_argument("--deviceInfo", action="store_true",
+                        help="accelerator inventory + HBM usage "
+                             "(no reference equivalent)")
     status.set_defaults(fn=clickhouse_status)
 
     sb = sub.add_parser("supportbundle")

@@ -311,21 +311,9 @@ class TsvDecoder:
             # The native decoder validates the whole block before
             # mutating any state, so every error leaves the decoder
             # (and the shared dictionaries) untouched.
-            if n == -2:
-                raise ValueError(
-                    "dictionary desync: block's delta base does not "
-                    "match the decoder's dictionary (blocks must be "
-                    "decoded in stream order)")
-            if n == -4:
-                raise ValueError(
-                    "flow block carries string codes outside its "
-                    "dictionary")
-            if n == -5:
-                raise ValueError(
-                    "dictionary desync: block's delta repeats an "
-                    "existing or intra-delta entry")
             if n < 0:
-                raise ValueError(f"malformed flow block ({n})")
+                raise ValueError(self._BLOCK_ERRORS.get(
+                    n, f"malformed flow block ({n})"))
             self._sync_dicts()
             return self._planes_to_batch(ints, codes, int(n))
         return self._decode_block_python(payload, n_rows, v2)

@@ -293,7 +293,7 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
             raise KeyError(self.path)
 
     _STATS_COMPONENTS = ("diskInfo", "tableInfo", "insertRate",
-                         "stackTraces")
+                         "stackTraces", "deviceInfo")
 
     def _get_stats(self, parts) -> None:
         if len(parts) < 4 or parts[3] != "clickhouse":
@@ -314,6 +314,11 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
             doc["insertRates"] = self.stats.insert_rates()
         if component in (None, "stackTraces"):
             doc["stackTraces"] = self.stats.stack_traces()
+        if component == "deviceInfo":
+            # Opt-in only (not part of the bare-resource GET): touching
+            # jax.devices() initializes a backend, which an operator
+            # polling basic store stats shouldn't pay for.
+            doc["deviceInfos"] = self.stats.device_infos()
         self._send_json(doc)
 
     def _get_system(self, parts) -> None:

@@ -41,7 +41,8 @@ class StatsProvider:
 
     def disk_infos(self) -> List[Dict[str, str]]:
         used = (self.db.flows.nbytes + self.db.tadetector.nbytes
-                + self.db.recommendations.nbytes)
+                + self.db.recommendations.nbytes
+                + self.db.dropdetection.nbytes)
         free = max(self.capacity_bytes - used, 0)
         return [{
             "shard": self.shard,
@@ -55,7 +56,7 @@ class StatsProvider:
     def table_infos(self) -> List[Dict[str, str]]:
         out = []
         for table in (self.db.flows, self.db.tadetector,
-                      self.db.recommendations):
+                      self.db.recommendations, self.db.dropdetection):
             out.append({
                 "shard": self.shard,
                 "database": "default",
@@ -98,4 +99,37 @@ class StatsProvider:
                 "threadId": str(tid),
                 "trace": "".join(traceback.format_stack(frame, limit=12)),
             })
+        return out
+
+    def device_infos(self) -> List[Dict[str, str]]:
+        """Accelerator inventory + HBM usage — observability the
+        reference has no equivalent for (its compute tier is opaque
+        Spark executors; ours is a visible device mesh). Served as the
+        `deviceInfo` stats component."""
+        out = []
+        try:
+            import jax
+            devices = jax.devices()
+        except Exception as e:  # no backend available (e.g. bare CI)
+            return [{"shard": self.shard, "error": str(e)}]
+        for dev in devices:
+            info = {
+                "shard": self.shard,
+                "deviceId": str(dev.id),
+                "platform": dev.platform,
+                "deviceKind": dev.device_kind,
+                "processIndex": str(dev.process_index),
+            }
+            try:
+                mem = dev.memory_stats() or {}
+                if "bytes_in_use" in mem:
+                    info["memoryBytesInUse"] = str(mem["bytes_in_use"])
+                if "bytes_limit" in mem:
+                    info["memoryBytesLimit"] = str(mem["bytes_limit"])
+                    limit = max(int(mem["bytes_limit"]), 1)
+                    info["memoryUsedPercentage"] = (
+                        f"{int(mem.get('bytes_in_use', 0)) / limit * 100:.2f}")
+            except Exception:
+                pass  # CPU devices and some backends expose no stats
+            out.append(info)
         return out
