@@ -110,6 +110,22 @@ def main() -> None:
         print(f"ingest bench skipped: {e}", file=sys.stderr)
 
     try:
+        from theia_tpu.store import FlowDatabase
+        host = generate_flows(SynthConfig(n_series=2000,
+                                          points_per_series=30))
+        FlowDatabase().insert_flows(host)   # warm native group-sum
+        best = 0.0
+        for _ in range(3):
+            db = FlowDatabase()
+            t9 = time.perf_counter()
+            db.insert_flows(host)
+            best = max(best, len(host) / (time.perf_counter() - t9))
+        print(f"store insert (3 MV fan-out): {best:,.0f} rows/s",
+              file=sys.stderr)
+    except Exception as e:
+        print(f"store bench skipped: {e}", file=sys.stderr)
+
+    try:
         from theia_tpu.analytics.streaming import StreamingDetector
         det = StreamingDetector(capacity=1024)
         S, T = cfg.n_series, cfg.points_per_series

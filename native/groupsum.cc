@@ -1,0 +1,129 @@
+// Native GROUP BY ... SUM for the materialized-view insert path.
+//
+// Plays the role of ClickHouse's SummingMergeTree per-insert-block
+// aggregation (the three MVs at build/charts/theia/provisioning/
+// datasources/create_table.sh:92-351): group an insert block by 9-20
+// integer key columns and sum 6-8 metric columns. The numpy path needs
+// a 15-20-key lexsort plus several full-matrix gathers; this is one
+// hash-grouping pass with sums accumulated in place — no sort at all
+// (part group order is irrelevant: exact lexsort-compaction happens at
+// read time, where ClickHouse also collapses parts).
+//
+// C API (ctypes; same .so as flowblock/seriesbuild):
+//   gs_build(key_cols, key_widths, n, k, val_cols, val_widths, m)
+//       key_cols/val_cols: arrays of column pointers (column-major
+//       input, no row-major staging copy in Python); widths are the
+//       per-column element sizes in bytes (4 = int32, 8 = int64).
+//       Returns a handle.
+//   gs_dims(h, &g)            number of groups
+//   gs_fill(h, out_keys, out_values)
+//       out_keys [g,k] int64 row-major, out_values [g,m] int64.
+//   gs_free(h)
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct GroupSum {
+  int64_t g = 0;
+  int32_t k = 0, m = 0;
+  std::vector<int64_t> keys;   // g*k, group-representative keys
+  std::vector<int64_t> sums;   // g*m
+};
+
+inline int64_t read_cell(const void* col, int32_t width, int64_t r) {
+  if (width == 8)
+    return static_cast<const int64_t*>(col)[r];
+  return static_cast<const int32_t*>(col)[r];  // width == 4
+}
+
+inline uint64_t mix(uint64_t x) {
+  x *= 0xff51afd7ed558ccdull;
+  x ^= x >> 33;
+  return x;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* gs_build(const void** key_cols, const int32_t* key_widths,
+               int64_t n, int32_t k,
+               const void** val_cols, const int32_t* val_widths,
+               int32_t m) {
+  auto* gs = new GroupSum();
+  gs->k = k;
+  gs->m = m;
+  if (n == 0) return gs;
+
+  // Stage keys row-major once (C loop beats k numpy astype+stack).
+  std::vector<int64_t> rows(static_cast<size_t>(n) * k);
+  for (int32_t c = 0; c < k; ++c) {
+    const void* col = key_cols[c];
+    const int32_t w = key_widths[c];
+    int64_t* out = rows.data() + c;
+    if (w == 8) {
+      const int64_t* src = static_cast<const int64_t*>(col);
+      for (int64_t r = 0; r < n; ++r) out[r * k] = src[r];
+    } else {
+      const int32_t* src = static_cast<const int32_t*>(col);
+      for (int64_t r = 0; r < n; ++r) out[r * k] = src[r];
+    }
+  }
+
+  size_t cap = 1;
+  while (cap < static_cast<size_t>(n) * 2) cap <<= 1;
+  std::vector<int64_t> slot_row(cap, -1);   // representative row
+  std::vector<int64_t> slot_gid(cap, -1);
+
+  gs->keys.reserve(static_cast<size_t>(n) * k / 4);
+  gs->sums.reserve(static_cast<size_t>(n) * m / 4);
+  for (int64_t r = 0; r < n; ++r) {
+    const int64_t* row = rows.data() + r * k;
+    uint64_t h = 1469598103934665603ull;
+    for (int32_t i = 0; i < k; ++i) {
+      h ^= mix(static_cast<uint64_t>(row[i]));
+      h *= 1099511628211ull;
+    }
+    h &= cap - 1;
+    int64_t gid;
+    for (;;) {
+      if (slot_row[h] < 0) {
+        gid = gs->g++;
+        slot_row[h] = r;
+        slot_gid[h] = gid;
+        gs->keys.insert(gs->keys.end(), row, row + k);
+        gs->sums.insert(gs->sums.end(), m, 0);
+        break;
+      }
+      if (!memcmp(rows.data() + slot_row[h] * k, row,
+                  static_cast<size_t>(k) * sizeof(int64_t))) {
+        gid = slot_gid[h];
+        break;
+      }
+      h = (h + 1) & (cap - 1);
+    }
+    int64_t* acc = gs->sums.data() + gid * m;
+    for (int32_t j = 0; j < m; ++j)
+      acc[j] += read_cell(val_cols[j], val_widths[j], r);
+  }
+  return gs;
+}
+
+void gs_dims(void* h, int64_t* g) {
+  *g = static_cast<GroupSum*>(h)->g;
+}
+
+void gs_fill(void* h, int64_t* out_keys, int64_t* out_values) {
+  auto* gs = static_cast<GroupSum*>(h);
+  memcpy(out_keys, gs->keys.data(),
+         gs->keys.size() * sizeof(int64_t));
+  memcpy(out_values, gs->sums.data(),
+         gs->sums.size() * sizeof(int64_t));
+}
+
+void gs_free(void* h) { delete static_cast<GroupSum*>(h); }
+
+}  // extern "C"

@@ -147,8 +147,8 @@ def run_drop_detection(db: FlowDatabase,
 
     if progress:
         progress.stage("write")
-    rows = _result_rows(db, part_keys, mat, dates, anomaly, mean, std,
-                        job_type, detection_id, now)
+    rows = _result_rows(flows, part_keys, mat, dates, anomaly, mean,
+                        std, job_type, detection_id, now)
     if rows:
         db.dropdetection.insert_rows(rows)
     if progress:
@@ -156,18 +156,21 @@ def run_drop_detection(db: FlowDatabase,
     return detection_id
 
 
-def _result_rows(db, part_keys, mat, dates, anomaly, mean, std,
+def _result_rows(flows, part_keys, mat, dates, anomaly, mean, std,
                  job_type, detection_id, now) -> List[Dict[str, object]]:
+    """`flows` is the scanned batch the partition keys were built from —
+    its dicts are the ONLY tables the codes are valid against (a sharded
+    scan re-encodes into merged dictionaries distinct from any shard's)."""
     created = int(now if now is not None else time.time())
-    name_dict = db.flows.dicts["sourcePodName"]
-    ns_dict = db.flows.dicts["sourcePodNamespace"]
-    ip_dict = db.flows.dicts["sourceIP"]
-    # All pod-name/ns/IP columns share per-column dicts; endpoint codes
+    name_dict = flows.dicts["sourcePodName"]
+    ns_dict = flows.dicts["sourcePodNamespace"]
+    ip_dict = flows.dicts["sourceIP"]
+    # All pod-name/ns/IP columns have per-column dicts; endpoint codes
     # were taken from whichever side was the victim, so decode against
     # the matching dict per column pair.
-    dst_name_dict = db.flows.dicts["destinationPodName"]
-    dst_ns_dict = db.flows.dicts["destinationPodNamespace"]
-    dst_ip_dict = db.flows.dicts["destinationIP"]
+    dst_name_dict = flows.dicts["destinationPodName"]
+    dst_ns_dict = flows.dicts["destinationPodNamespace"]
+    dst_ip_dict = flows.dicts["destinationIP"]
 
     rows: List[Dict[str, object]] = []
     sidx, didx = np.nonzero(anomaly)

@@ -37,6 +37,8 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 _SRC = os.path.join(_REPO_ROOT, "native", "flowblock.cc")
 _SRC_SERIES = os.path.join(_REPO_ROOT, "native", "seriesbuild.cc")
+_SRC_GROUPSUM = os.path.join(_REPO_ROOT, "native", "groupsum.cc")
+_ALL_SRCS = (_SRC, _SRC_SERIES, _SRC_GROUPSUM)
 _BUILD_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "_build")
 
@@ -48,7 +50,7 @@ def _so_path() -> str:
     change with the sources)."""
     import hashlib
     h = hashlib.sha1()
-    for src in (_SRC, _SRC_SERIES):
+    for src in _ALL_SRCS:
         with open(src, "rb") as f:
             h.update(f.read())
     return os.path.join(_BUILD_DIR, f"flowblock-{h.hexdigest()[:12]}.so")
@@ -93,7 +95,7 @@ def _compile(so: str) -> None:
     try:
         subprocess.run(
             ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-             "-o", tmp, _SRC, _SRC_SERIES],
+             "-o", tmp, *_ALL_SRCS],
             check=True, capture_output=True, text=True)
         os.replace(tmp, so)
     finally:
@@ -143,6 +145,18 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_int64),
         ctypes.POINTER(ctypes.c_uint8)]
     lib.sb_free.argtypes = [ctypes.c_void_p]
+    lib.gs_build.restype = ctypes.c_void_p
+    lib.gs_build.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int64, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int32]
+    lib.gs_dims.argtypes = [ctypes.c_void_p,
+                            ctypes.POINTER(ctypes.c_int64)]
+    lib.gs_fill.argtypes = [ctypes.c_void_p,
+                            ctypes.POINTER(ctypes.c_int64),
+                            ctypes.POINTER(ctypes.c_int64)]
+    lib.gs_free.argtypes = [ctypes.c_void_p]
     return lib
 
 
@@ -615,3 +629,48 @@ def build_padded_series(keys: np.ndarray, times: np.ndarray,
         lib.sb_free(handle)
     return key_mat, vals.astype(dtype, copy=False), ts, \
         mask.astype(bool)
+
+
+def native_group_sum(key_cols, value_cols):
+    """Native GROUP BY...SUM over column arrays (native/groupsum.cc):
+    one hash pass, no sort, no row-major staging in Python — the
+    materialized-view insert hot path. Group order is arbitrary
+    (SummingMergeTree parts are re-grouped exactly at read time).
+
+    key_cols / value_cols: sequences of 1-D int32/int64 arrays of equal
+    length. Returns (keys [g,k] int64, sums [g,m] int64), or None when
+    the native library is unavailable.
+    """
+    lib = _load_library()
+    if lib is None:
+        return None
+    key_cols = [np.ascontiguousarray(a) for a in key_cols]
+    value_cols = [np.ascontiguousarray(a) for a in value_cols]
+    for a in (*key_cols, *value_cols):
+        if a.dtype not in (np.dtype(np.int32), np.dtype(np.int64)):
+            return None   # unexpected dtype → numpy fallback
+    n = len(key_cols[0]) if key_cols else 0
+    for a in (*key_cols, *value_cols):
+        if len(a) != n:  # C reads n cells per column — no OOB reads
+            raise ValueError(
+                f"column length mismatch: {len(a)} != {n}")
+    k, m = len(key_cols), len(value_cols)
+    kp = (ctypes.c_void_p * k)(*[a.ctypes.data for a in key_cols])
+    kw = (ctypes.c_int32 * k)(*[a.dtype.itemsize for a in key_cols])
+    vp = (ctypes.c_void_p * max(m, 1))(
+        *[a.ctypes.data for a in value_cols])
+    vw = (ctypes.c_int32 * max(m, 1))(
+        *[a.dtype.itemsize for a in value_cols])
+    handle = lib.gs_build(kp, kw, n, k, vp, vw, m)
+    try:
+        g = ctypes.c_int64()
+        lib.gs_dims(handle, ctypes.byref(g))
+        keys = np.empty((g.value, k), np.int64)
+        sums = np.empty((g.value, m), np.int64)
+        lib.gs_fill(
+            handle,
+            keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            sums.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    finally:
+        lib.gs_free(handle)
+    return keys, sums

@@ -19,6 +19,8 @@ keeping the read path for dashboards O(view rows), not O(flow rows).
 
 from __future__ import annotations
 
+import concurrent.futures
+import os
 import threading
 from typing import Dict, List, Mapping, Optional, Sequence
 
@@ -33,6 +35,20 @@ from ..schema import (
     StringDictionary,
 )
 from .views import MATERIALIZED_VIEWS, ViewTable
+
+_VIEW_POOL: Optional[concurrent.futures.ThreadPoolExecutor] = None
+_VIEW_POOL_LOCK = threading.Lock()
+
+
+def _view_pool() -> concurrent.futures.ThreadPoolExecutor:
+    """Shared pool for parallel MV fan-out (native group-sum releases
+    the GIL, so the three aggregations genuinely overlap)."""
+    global _VIEW_POOL
+    with _VIEW_POOL_LOCK:
+        if _VIEW_POOL is None:
+            _VIEW_POOL = concurrent.futures.ThreadPoolExecutor(
+                max_workers=4, thread_name_prefix="mv-fanout")
+        return _VIEW_POOL
 
 
 class Table:
@@ -266,9 +282,20 @@ class FlowDatabase:
         if adopted is None:
             return 0
         # Views consume the adopted (store-coded) batch so their group
-        # keys share the store dictionaries.
-        for view in self.views.values():
-            view.apply_insert_block(adopted)
+        # keys share the store dictionaries. The three aggregations are
+        # independent and the native group-sum releases the GIL, so fan
+        # out in parallel for large blocks (ClickHouse runs MV pipelines
+        # per insert block concurrently too).
+        views = list(self.views.values())
+        if (len(adopted) >= 16384 and len(views) > 1
+                and (os.cpu_count() or 1) > 2):
+            # Parallel only where cores exist (TPU hosts); on small
+            # boxes the three aggregations just fight over one core.
+            list(_view_pool().map(
+                lambda v: v.apply_insert_block(adopted), views))
+        else:
+            for view in views:
+                view.apply_insert_block(adopted)
         if self.ttl_seconds is not None:
             now = int(now if now is not None
                       else np.max(adopted["timeInserted"]))

@@ -175,7 +175,7 @@ class ShardedFlowDatabase:
         # One Generator per table: each DistributedTable serializes its
         # own rand() stream under its own lock; sharing one Generator
         # across tables would race (Generators are not thread-safe).
-        seqs = np.random.SeedSequence(seed).spawn(3)
+        seqs = np.random.SeedSequence(seed).spawn(4)
         self.ttl_seconds = ttl_seconds
         self.flows = DistributedTable(
             "flows", [s.flows for s in self.shards],
@@ -187,6 +187,10 @@ class ShardedFlowDatabase:
             "recommendations",
             [s.recommendations for s in self.shards],
             np.random.default_rng(seqs[2]))
+        self.dropdetection = DistributedTable(
+            "dropdetection",
+            [s.dropdetection for s in self.shards],
+            np.random.default_rng(seqs[3]))
         self.views: Dict[str, DistributedView] = {
             name: DistributedView(name,
                                   [s.views[name] for s in self.shards])
@@ -246,7 +250,8 @@ class ShardedFlowDatabase:
         if len(flows):
             merged.flows.insert(flows)
         for src, dst in ((self.tadetector, merged.tadetector),
-                         (self.recommendations, merged.recommendations)):
+                         (self.recommendations, merged.recommendations),
+                         (self.dropdetection, merged.dropdetection)):
             data = src.scan()
             if len(data):
                 dst.insert(data)
@@ -266,7 +271,8 @@ class ShardedFlowDatabase:
         if len(flows):
             db.insert_flows(flows)
         for src, dst in ((single.tadetector, db.tadetector),
-                         (single.recommendations, db.recommendations)):
+                         (single.recommendations, db.recommendations),
+                         (single.dropdetection, db.dropdetection)):
             data = src.scan()
             if len(data):
                 dst.insert(data)

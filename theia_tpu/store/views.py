@@ -56,6 +56,38 @@ def group_sum(keys: np.ndarray, values: np.ndarray
     return group_reduce(keys, values, "sum")
 
 
+def group_sum_fast(keys: np.ndarray, values: np.ndarray
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-insert-block GROUP BY for the MV hot path: sort by a single
+    64-bit row hash instead of lexsorting 15-20 key columns (~20x less
+    sort work). Output group ORDER is arbitrary, and a hash collision
+    between distinct keys may split a group into two rows — both are
+    fine for a SummingMergeTree part: `compact()`/`_merged` re-groups
+    exactly (lexsort) at read time, which is also where ClickHouse
+    collapses part rows. Do NOT use where callers rely on lexicographic
+    group order (use group_reduce)."""
+    n = keys.shape[0]
+    if n == 0:
+        return keys, values
+    h = np.full(n, 0xcbf29ce484222325, np.uint64)
+    for i in range(keys.shape[1]):
+        x = keys[:, i].astype(np.uint64)
+        x *= np.uint64(0xff51afd7ed558ccd)
+        x ^= x >> np.uint64(33)
+        h ^= x
+        h *= np.uint64(0x100000001b3)
+    order = np.argsort(h, kind="stable")
+    sk = keys[order]
+    sv = values[order]
+    boundary = np.empty(n, dtype=bool)
+    boundary[0] = True
+    # Full-row compare: equal keys are adjacent (equal hash); colliding
+    # distinct keys interleaved in a run just produce extra boundaries.
+    boundary[1:] = np.any(sk[1:] != sk[:-1], axis=1)
+    starts = np.flatnonzero(boundary)
+    return sk[starts], np.add.reduceat(sv, starts, axis=0)
+
+
 def materialize_view_batch(spec: "ViewSpec", keys: np.ndarray,
                            values: np.ndarray,
                            dicts: Dict[str, StringDictionary]
@@ -146,14 +178,22 @@ class ViewTable:
 
     def apply_insert_block(self, block: ColumnarBatch) -> None:
         """Aggregate one flows insert block into this view (the MV SELECT
-        ... GROUP BY per inserted block)."""
-        keys = np.stack([np.asarray(block[c], np.int64)
-                         for c in self.spec.key_columns], axis=1)
-        values = np.stack([np.asarray(block[c], np.int64)
-                           for c in self.spec.sum_columns], axis=1)
-        gk, gv = group_sum(keys, values)
+        ... GROUP BY per inserted block). Native single-pass hash
+        grouping when available (native/groupsum.cc); numpy hash-sort
+        otherwise — both emit unordered SummingMergeTree parts that
+        compact() re-groups exactly at read time."""
+        from ..ingest.native import native_group_sum
+        out = native_group_sum(
+            [block[c] for c in self.spec.key_columns],
+            [block[c] for c in self.spec.sum_columns])
+        if out is None:
+            keys = np.stack([np.asarray(block[c], np.int64)
+                             for c in self.spec.key_columns], axis=1)
+            values = np.stack([np.asarray(block[c], np.int64)
+                               for c in self.spec.sum_columns], axis=1)
+            out = group_sum_fast(keys, values)
         with self._lock:
-            self._parts.append((gk, gv))
+            self._parts.append(out)
 
     def _merged(self) -> Tuple[np.ndarray, np.ndarray]:
         with self._lock:
