@@ -125,3 +125,18 @@ def test_dashboard_api_time_window_params(db):
         assert all(r["flowEndSeconds"] < t0 + 5 for r in rows)
     finally:
         srv.shutdown()
+
+
+def test_homepage_bargauge_and_timeseries(db):
+    data = queries.homepage(db)
+    assert data["topNamespaces"], "bargauge data expected"
+    assert all(t["value"] > 0 for t in data["topNamespaces"])
+    # descending order, namespaces decoded
+    values = [t["value"] for t in data["topNamespaces"]]
+    assert values == sorted(values, reverse=True)
+    assert data["throughput"]["times"]
+    assert "cluster" in data["throughput"]["series"]
+    assert data["droppedFlowCount"] >= 0
+    from theia_tpu.dashboards.web import render
+    html = render("homepage", db)
+    assert "top namespaces" in html and "cluster throughput" in html

@@ -74,12 +74,16 @@ def _throughput_series(times: np.ndarray, groups: np.ndarray,
 
 def homepage(db: FlowDatabase) -> Dict[str, object]:
     """Cluster summary (reference homepage.json: 12 stat panels +
-    bargauge + dashlist)."""
+    bargauge of top namespaces + cluster-throughput timeseries +
+    dashlist — the dashlist is the nav bar on every page)."""
     flows = db.flows.scan()
     out: Dict[str, object] = {
         "flowCount": len(flows),
         "tadAnomalies": 0,
         "recommendations": 0,
+        "droppedFlowCount": 0,
+        "topNamespaces": [],
+        "throughput": {"times": [], "series": {}},
     }
     if len(flows):
         for stat, col in (("podCount", "sourcePodName"),
@@ -93,10 +97,37 @@ def homepage(db: FlowDatabase) -> Dict[str, object]:
         out["currentThroughput"] = int(
             flows["throughput"][flows["timeInserted"]
                                 == flows["timeInserted"].max()].sum())
+        ingress = np.asarray(flows["ingressNetworkPolicyRuleAction"])
+        egress = np.asarray(flows["egressNetworkPolicyRuleAction"])
+        out["droppedFlowCount"] = int((np.isin(ingress, (2, 3))
+                                       | np.isin(egress, (2, 3))).sum())
+        # bargauge: top namespaces by traffic volume
+        ns = np.asarray(flows["sourcePodNamespace"], np.int64)
+        octets = np.asarray(flows["octetDeltaCount"], np.float64)
+        names = flows.dicts["sourcePodNamespace"]
+        totals = np.bincount(ns, weights=octets)
+        if len(totals):
+            totals[0] = 0              # code 0 == '' (no namespace)
+        top = np.argsort(-totals)[:8]
+        out["topNamespaces"] = [
+            {"name": names.decode_one(int(g)), "value": int(totals[g])}
+            for g in top if totals[g] > 0]
+        # timeseries: cluster-wide throughput (single group, so a
+        # two-line bincount instead of _throughput_series' per-row
+        # Python loops — this runs on every homepage render)
+        t_axis, inv = np.unique(
+            np.asarray(flows["flowEndSeconds"], np.int64),
+            return_inverse=True)
+        ys = np.bincount(
+            inv, weights=np.asarray(flows["throughput"], np.float64))
+        out["throughput"] = {
+            "times": t_axis.tolist(),
+            "series": {"cluster": ys.astype(np.int64).tolist()}}
     tad = db.tadetector.scan()
     if len(tad):
         out["tadAnomalies"] = int(
             (tad.strings("anomaly") == "true").sum())
+    out["dropAnomalies"] = len(db.dropdetection)
     out["recommendations"] = len(db.recommendations)
     return out
 

@@ -241,7 +241,15 @@ def render(name: str, db) -> str:
         raise KeyError(name)
     data = queries.DASHBOARDS[name](db)
     if name == "homepage":
-        body = stat_tiles(data)
+        scalars = {k: v for k, v in data.items()
+                   if not isinstance(v, (dict, list))}
+        body = stat_tiles(scalars)
+        if data.get("topNamespaces"):
+            body += (f"<h2>top namespaces by traffic</h2>"
+                     f"{svg_barlist(data['topNamespaces'])}")
+        if data.get("throughput", {}).get("times"):
+            body += (f"<h2>cluster throughput</h2>"
+                     f"{svg_lines(data['throughput'])}")
     elif name == "flow_records":
         body = table(data)
     elif name in ("pod_to_pod", "pod_to_service", "pod_to_external"):
