@@ -1,0 +1,110 @@
+"""Streaming CMS + online k-means heavy-hitter / DDoS detection."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from theia_tpu.analytics.heavy_hitters import HeavyHitterDetector
+from theia_tpu.ops.sketch import (
+    cms_init,
+    cms_query,
+    cms_update,
+    kmeans_init,
+    kmeans_step,
+)
+from theia_tpu.schema import FLOW_SCHEMA, ColumnarBatch
+
+
+def test_cms_estimates_upper_bound_and_exact_when_sparse():
+    state = cms_init(depth=4, width=4096)
+    keys = np.arange(1, 101, dtype=np.uint32)
+    vols = np.linspace(10, 1000, 100).astype(np.float32)
+    state = cms_update(state, jnp.asarray(keys), jnp.asarray(vols))
+    est = np.asarray(cms_query(state, jnp.asarray(keys)))
+    # CMS never underestimates; with 100 keys in 4x4096 it is exact.
+    assert np.all(est >= vols - 1e-3)
+    np.testing.assert_allclose(est, vols, rtol=1e-6)
+    assert float(state.total) == pytest.approx(vols.sum(), rel=1e-6)
+
+
+def test_cms_accumulates_across_batches():
+    state = cms_init(depth=4, width=1024)
+    key = jnp.asarray(np.asarray([7], np.uint32))
+    for _ in range(5):
+        state = cms_update(state, key, jnp.asarray([100.0]))
+    assert float(np.asarray(cms_query(state, key))[0]) \
+        == pytest.approx(500.0)
+
+
+def test_kmeans_minibatch_converges_to_cluster_means():
+    rng = np.random.default_rng(0)
+    a = rng.normal((0, 0, 0, 0), 0.1, size=(500, 4))
+    b = rng.normal((5, 5, 5, 5), 0.1, size=(500, 4))
+    pts = np.concatenate([a, b]).astype(np.float32)
+    state = kmeans_init(np.asarray([[0.5] * 4, [4.5] * 4], np.float32))
+    for _ in range(20):
+        order = rng.permutation(len(pts))[:128]
+        state, assign, dist = kmeans_step(state, jnp.asarray(pts[order]))
+    c = np.sort(np.asarray(state.centroids)[:, 0])
+    assert abs(c[0] - 0.0) < 0.3 and abs(c[1] - 5.0) < 0.3
+
+
+def _flow_batch(dst_ips, octets, packets, dicts=None):
+    rows = [{"destinationIP": d, "sourceIP": f"10.9.{i%250}.{i%199}",
+             "octetDeltaCount": int(o), "packetDeltaCount": int(p)}
+            for i, (d, o, p) in enumerate(zip(dst_ips, octets, packets))]
+    return ColumnarBatch.from_rows(rows, FLOW_SCHEMA, dicts)
+
+
+def test_flood_destination_raises_heavy_hitter_alert():
+    det = HeavyHitterDetector(hh_fraction=0.2, seed=1)
+    rng = np.random.default_rng(2)
+    dicts = None
+    for _ in range(4):   # background: 50 dsts, even volume
+        dsts = [f"10.0.0.{i}" for i in range(50)]
+        batch = _flow_batch(dsts, rng.integers(900, 1100, 50),
+                            rng.integers(1, 5, 50), dicts)
+        dicts = batch.dicts
+        det.update(batch)
+    # flood: one destination takes ~90% of new volume
+    flood = _flow_batch(["10.66.66.66"] * 40 + ["10.0.0.1"] * 10,
+                        [200_000] * 40 + [1000] * 10,
+                        [200] * 40 + [2] * 10, dicts)
+    alerts = det.update(flood)
+    hh = [a for a in alerts if a.kind == "heavy_hitter"]
+    assert any(a.destination == "10.66.66.66" for a in hh)
+    victim = next(a for a in hh if a.destination == "10.66.66.66")
+    assert victim.share > 0.2
+    # background destinations stay quiet
+    assert not any(a.destination == "10.0.0.5" for a in hh)
+
+
+def test_shape_outliers_flagged_after_warmup():
+    det = HeavyHitterDetector(hh_fraction=0.99,  # mute volume alerts
+                              ddos_sigma=4.0, seed=3)
+    rng = np.random.default_rng(4)
+    dicts = None
+    for _ in range(6):   # normal traffic: moderate flows
+        batch = _flow_batch(
+            [f"10.0.0.{i}" for i in range(32)],
+            rng.integers(5_000, 15_000, 32),
+            rng.integers(5, 15, 32), dicts)
+        dicts = batch.dicts
+        det.update(batch)
+    # anomaly: massive fan-in of tiny single-packet flows to one dst
+    weird = _flow_batch(["10.200.0.1"] * 64,
+                        [40] * 64, [1] * 64, dicts)
+    alerts = det.update(weird)
+    shapes = [a for a in alerts if a.kind == "ddos_shape"]
+    assert shapes, "expected traffic-shape outlier alerts"
+    assert all(a.destination == "10.200.0.1" for a in shapes)
+
+
+def test_volume_estimate_query():
+    det = HeavyHitterDetector(seed=5)
+    batch = _flow_batch(["10.1.1.1"] * 3, [100, 200, 300], [1, 2, 3])
+    det.update(batch)
+    code = batch.dicts["destinationIP"].lookup("10.1.1.1")
+    assert det.volume_estimate(code) == pytest.approx(600.0)

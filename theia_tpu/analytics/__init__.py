@@ -2,6 +2,8 @@
 and abnormal traffic-drop detection."""
 
 from .drop_detection import run_drop_detection
+from .heavy_hitters import HeavyHitterAlert, HeavyHitterDetector
+from .itemsets import mine_frequent_patterns
 from .npr import (NAMESPACE_ALLOW_LIST, read_distinct_flows, run_npr)
 from .series import SeriesBatch, TadQuerySpec, build_series
 from .streaming import StreamingDetector, stream_update
@@ -13,4 +15,6 @@ __all__ = [
     "NAMESPACE_ALLOW_LIST", "read_distinct_flows", "run_npr",
     "StreamingDetector", "stream_update",
     "run_drop_detection",
+    "HeavyHitterAlert", "HeavyHitterDetector",
+    "mine_frequent_patterns",
 ]

@@ -25,6 +25,11 @@ from ..store.views import group_reduce
 
 FLOW_TYPE_TO_EXTERNAL = 3
 
+# NetworkPolicy rule-action codes (reference schema: 0 none, 1 allow,
+# 2 drop, 3 reject) — single source for every dashboard consumer.
+RULE_ACTION_LABELS = {0: "none", 1: "allow", 2: "drop", 3: "reject"}
+DENY_RULE_ACTIONS = (2, 3)
+
 
 def _top_links(keys: np.ndarray, values: np.ndarray, names_a, names_b,
                k: int) -> List[Dict[str, object]]:
@@ -53,22 +58,22 @@ def _time_window(col: np.ndarray, start: Optional[int],
 def _throughput_series(times: np.ndarray, groups: np.ndarray,
                        values: np.ndarray, names, k: int
                        ) -> Dict[str, object]:
-    """Per-group throughput over time for the top-k groups by volume."""
+    """Per-group throughput over time for the top-k groups by volume.
+    Fully vectorized (unique + bincount) — this runs on every dashboard
+    render over the whole selected window."""
     if len(times) == 0:
         return {"times": [], "series": {}}
-    totals: Dict[int, int] = {}
-    for g, v in zip(groups.tolist(), values.tolist()):
-        totals[g] = totals.get(g, 0) + v
-    top = sorted(totals, key=totals.get, reverse=True)[:k]
-    t_axis = np.unique(times)
-    t_index = {int(t): i for i, t in enumerate(t_axis)}
+    values = np.asarray(values, np.float64)
+    uniq_g, g_inv = np.unique(groups, return_inverse=True)
+    totals = np.bincount(g_inv, weights=values)
+    top = np.argsort(-totals)[:k]
+    t_axis, t_inv = np.unique(times, return_inverse=True)
     series = {}
-    for g in top:
-        sel = groups == g
-        ys = np.zeros(len(t_axis), np.int64)
-        for t, v in zip(times[sel], values[sel]):
-            ys[t_index[int(t)]] += int(v)
-        series[str(names[g])] = ys.tolist()
+    for gi in top:
+        sel = g_inv == gi
+        ys = np.bincount(t_inv[sel], weights=values[sel],
+                         minlength=len(t_axis))
+        series[str(names[uniq_g[gi]])] = ys.astype(np.int64).tolist()
     return {"times": t_axis.tolist(), "series": series}
 
 
@@ -99,8 +104,9 @@ def homepage(db: FlowDatabase) -> Dict[str, object]:
                                 == flows["timeInserted"].max()].sum())
         ingress = np.asarray(flows["ingressNetworkPolicyRuleAction"])
         egress = np.asarray(flows["egressNetworkPolicyRuleAction"])
-        out["droppedFlowCount"] = int((np.isin(ingress, (2, 3))
-                                       | np.isin(egress, (2, 3))).sum())
+        out["droppedFlowCount"] = int(
+            (np.isin(ingress, DENY_RULE_ACTIONS)
+             | np.isin(egress, DENY_RULE_ACTIONS)).sum())
         # bargauge: top namespaces by traffic volume
         ns = np.asarray(flows["sourcePodNamespace"], np.int64)
         octets = np.asarray(flows["octetDeltaCount"], np.float64)
@@ -112,17 +118,12 @@ def homepage(db: FlowDatabase) -> Dict[str, object]:
         out["topNamespaces"] = [
             {"name": names.decode_one(int(g)), "value": int(totals[g])}
             for g in top if totals[g] > 0]
-        # timeseries: cluster-wide throughput (single group, so a
-        # two-line bincount instead of _throughput_series' per-row
-        # Python loops — this runs on every homepage render)
-        t_axis, inv = np.unique(
+        # timeseries: cluster-wide throughput (one constant group)
+        out["throughput"] = _throughput_series(
             np.asarray(flows["flowEndSeconds"], np.int64),
-            return_inverse=True)
-        ys = np.bincount(
-            inv, weights=np.asarray(flows["throughput"], np.float64))
-        out["throughput"] = {
-            "times": t_axis.tolist(),
-            "series": {"cluster": ys.astype(np.int64).tolist()}}
+            np.zeros(len(flows), np.int64),
+            np.asarray(flows["throughput"], np.int64),
+            {0: "cluster"}, 1)
     tad = db.tadetector.scan()
     if len(tad):
         out["tadAnomalies"] = int(
@@ -231,8 +232,7 @@ def networkpolicy(db: FlowDatabase, k: int = 10, start=None, end=None):
                        names_e, names_i, k)
     by_action: Dict[str, int] = {}
     for act, v in zip(eg_act.tolist(), octets.tolist()):
-        label = {0: "none", 1: "allow", 2: "drop",
-                 3: "reject"}.get(act, str(act))
+        label = RULE_ACTION_LABELS.get(act, str(act))
         by_action[label] = by_action.get(label, 0) + v
     return {"chord": links,
             "byAction": [{"name": n, "value": v}

@@ -1,0 +1,146 @@
+"""Count-Min-Sketch + online k-means device kernels.
+
+The BASELINE north-star streaming config: "Count-Min-Sketch + online
+k-means heavy-hitter / DDoS detection at line rate from live Antrea
+FlowExporter". Both structures live device-resident and advance one
+fused XLA step per ingest micro-batch:
+
+  * CMS — D hash rows x W counters of traffic volume keyed by integer
+    flow keys. Update is a scatter-add per row; query is min over the
+    D estimates (classic CMS upper bound). Everything is batched: one
+    `update` call processes the whole micro-batch.
+  * Online k-means — mini-batch k-means (Sculley 2010 web-scale
+    formulation: per-batch assignment + per-centroid learning-rate
+    update with counts as the rate denominator). Distance computation
+    is one [N,K] matmul-shaped pass — MXU work, not a Python loop.
+
+No reference equivalent: Theia has no streaming analytics at all.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# Distinct odd 32-bit seeds per hash row. All sketch hashing is uint32
+# so it works with JAX's default x64-disabled mode (TPU production
+# path) as well as the x64 test configuration.
+_HASH_SEEDS = (
+    0x9E3779B9, 0xBF58476D, 0x94D049BB, 0xD6E8FEB8, 0xA5A5A5A5,
+    0xC2B2AE3D,
+)
+
+
+class CmsState(NamedTuple):
+    counts: jnp.ndarray    # [D, W] float32 volume counters
+    total: jnp.ndarray     # scalar: total volume seen
+
+
+def cms_init(depth: int = 4, width: int = 8192) -> CmsState:
+    if depth > len(_HASH_SEEDS):
+        raise ValueError(f"depth must be <= {len(_HASH_SEEDS)}")
+    if width <= 0 or width & (width - 1):
+        # slot masking is `h & (width-1)` — any other width silently
+        # strands counters and inflates collisions
+        raise ValueError(f"width must be a power of two, got {width}")
+    return CmsState(counts=jnp.zeros((depth, width), jnp.float32),
+                    total=jnp.zeros((), jnp.float32))
+
+
+def _cms_slots(keys: jnp.ndarray, depth: int, width: int) -> jnp.ndarray:
+    """keys [N] uint32 → [D, N] counter indices (width power of two);
+    murmur3-finalizer mixing, distinct seed per row."""
+    rows = []
+    for d in range(depth):
+        h = keys ^ jnp.uint32(_HASH_SEEDS[d])
+        h ^= h >> jnp.uint32(16)
+        h *= jnp.uint32(0x85EBCA6B)
+        h ^= h >> jnp.uint32(13)
+        h *= jnp.uint32(0xC2B2AE35)
+        h ^= h >> jnp.uint32(16)
+        rows.append((h & jnp.uint32(width - 1)).astype(jnp.int32))
+    return jnp.stack(rows)
+
+
+@partial(jax.jit, static_argnames=("depth", "width"))
+def _cms_update(counts, total, keys, volumes, *, depth, width):
+    slots = _cms_slots(keys, depth, width)          # [D, N]
+    def add_row(row, idx):
+        return row.at[idx].add(volumes)
+    counts = jax.vmap(add_row)(counts, slots)
+    return counts, total + volumes.sum()
+
+
+def cms_update(state: CmsState, keys: jnp.ndarray,
+               volumes: jnp.ndarray) -> CmsState:
+    """Scatter one micro-batch of (key, volume) into the sketch."""
+    d, w = state.counts.shape
+    counts, total = _cms_update(state.counts, state.total,
+                                keys.astype(jnp.uint32),
+                                volumes.astype(jnp.float32),
+                                depth=d, width=w)
+    return CmsState(counts, total)
+
+
+@partial(jax.jit, static_argnames=("depth", "width"))
+def _cms_query(counts, keys, *, depth, width):
+    slots = _cms_slots(keys, depth, width)          # [D, N]
+    ests = jax.vmap(lambda row, idx: row[idx])(counts, slots)
+    return ests.min(axis=0)
+
+
+def cms_query(state: CmsState, keys: jnp.ndarray) -> jnp.ndarray:
+    """Estimated volume per key (CMS upper bound, min over rows)."""
+    d, w = state.counts.shape
+    return _cms_query(state.counts, keys.astype(jnp.uint32),
+                      depth=d, width=w)
+
+
+class KMeansState(NamedTuple):
+    centroids: jnp.ndarray   # [K, F]
+    counts: jnp.ndarray      # [K] points assigned so far
+
+
+def kmeans_init(centroids: jnp.ndarray) -> KMeansState:
+    centroids = jnp.asarray(centroids, jnp.float32)
+    return KMeansState(centroids=centroids,
+                       counts=jnp.zeros(centroids.shape[0], jnp.float32))
+
+
+@jax.jit
+def kmeans_step(state: KMeansState, points: jnp.ndarray,
+                valid: Optional[jnp.ndarray] = None
+                ) -> Tuple[KMeansState, jnp.ndarray, jnp.ndarray]:
+    """One mini-batch update. points [N, F] → (state', assignment [N],
+    distance [N] to the assigned centroid). `valid` [N] bool masks out
+    padding rows (callers pad batches to fixed sizes to avoid per-size
+    XLA retraces): invalid rows get assignment/distance but contribute
+    nothing to the centroid update."""
+    points = points.astype(jnp.float32)
+    if valid is None:
+        valid = jnp.ones(points.shape[0], bool)
+    vf = valid.astype(jnp.float32)
+    # [N, K] squared distances as matmul-shaped work (MXU-friendly).
+    x2 = (points * points).sum(-1, keepdims=True)
+    c2 = (state.centroids * state.centroids).sum(-1)
+    d2 = x2 + c2[None, :] - 2.0 * points @ state.centroids.T
+    assign = jnp.argmin(d2, axis=1)
+    dist = jnp.sqrt(jnp.maximum(
+        jnp.take_along_axis(d2, assign[:, None], axis=1)[:, 0], 0.0))
+    # Mini-batch centroid update: per-centroid batch mean pulled in with
+    # learning rate batch_n / (counts + batch_n).
+    k = state.centroids.shape[0]
+    one_hot = jax.nn.one_hot(assign, k, dtype=jnp.float32) * vf[:, None]
+    batch_n = one_hot.sum(0)                                 # [K]
+    batch_sum = one_hot.T @ points                           # [K, F]
+    new_counts = state.counts + batch_n
+    safe_n = jnp.maximum(batch_n, 1.0)
+    batch_mean = batch_sum / safe_n[:, None]
+    rate = jnp.where(new_counts > 0, batch_n / jnp.maximum(new_counts, 1.0),
+                     0.0)
+    centroids = (state.centroids
+                 + rate[:, None] * (batch_mean - state.centroids))
+    return KMeansState(centroids, new_counts), assign, dist
