@@ -3,6 +3,7 @@
 import io
 import json
 import tarfile
+import urllib.error
 import urllib.request
 
 import pytest
@@ -192,3 +193,106 @@ def test_device_info_stats(server):
     bare = _get(server,
                 "/apis/stats.theia.antrea.io/v1alpha1/clickhouse")
     assert "deviceInfos" not in bare
+
+
+def test_network_ingest_and_alerts(server):
+    """POST /ingest (TFB2 block + TSV) feeds the store and the
+    streaming detector; GET /alerts serves heavy-hitter alerts — the
+    Flow-Aggregator-over-the-wire contract the reference serves via
+    ClickHouse native TCP."""
+    from theia_tpu.ingest import BlockEncoder, encode_tsv
+    from theia_tpu.schema import FLOW_SCHEMA, ColumnarBatch
+
+    before = len(server.controller.db.flows)
+
+    def _rows(dst, n, octets):
+        return [{"destinationIP": dst, "sourceIP": f"10.8.0.{i % 97}",
+                 "octetDeltaCount": octets, "packetDeltaCount": 2,
+                 "timeInserted": 1_700_000_000 + i} for i in range(n)]
+
+    enc = BlockEncoder()
+    batch = ColumnarBatch.from_rows(
+        _rows("10.0.0.1", 50, 1000), FLOW_SCHEMA, enc.dicts)
+
+    def _post_raw(path, payload):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}{path}", method="POST",
+            data=payload,
+            headers={"Content-Type": "application/octet-stream"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return json.loads(r.read())
+
+    out = _post_raw("/ingest", enc.encode(batch))
+    assert out["rows"] == 50
+    assert len(server.controller.db.flows) == before + 50
+
+    # TSV payload on its own stream (a TSV decode advances that
+    # stream's dictionaries, so mixing it into a TFB2 stream would
+    # break the block delta chain — streams isolate producers)
+    tsv_batch = ColumnarBatch.from_rows(
+        _rows("10.0.0.2", 10, 1000), FLOW_SCHEMA)
+    out = _post_raw("/ingest?stream=tsv", encode_tsv(tsv_batch))
+    assert out["rows"] == 10
+
+    # flood one destination → heavy-hitter alert on GET /alerts
+    flood = ColumnarBatch.from_rows(
+        _rows("10.99.99.99", 60, 500_000), FLOW_SCHEMA, enc.dicts)
+    _post_raw("/ingest", enc.encode(flood))
+    doc = _get(server, "/alerts?limit=50")
+    assert doc["rowsIngested"] >= 120
+    hh = [a for a in doc["alerts"] if a["kind"] == "heavy_hitter"]
+    assert any(a["destination"] == "10.99.99.99" for a in hh)
+
+    # malformed payload → 400, store unchanged
+    n_now = len(server.controller.db.flows)
+    try:
+        _post_raw("/ingest", b"not a flow payload at all")
+        assert False, "expected HTTP 400"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+    assert len(server.controller.db.flows) == n_now
+
+
+def test_ingest_stream_resets_on_failure(server):
+    """A payload that fails decode resets its stream (a partially
+    applied TSV decode would desync the dictionary chain); the stream
+    works again with a fresh encoder, and bad Content-Length inputs
+    are rejected without hanging the worker."""
+    from theia_tpu.ingest import BlockEncoder, encode_tsv
+    from theia_tpu.schema import FLOW_SCHEMA, ColumnarBatch
+
+    def _post_raw(path, payload, headers=None):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}{path}", method="POST",
+            data=payload, headers=headers or {})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return json.loads(r.read())
+
+    good_rows = [{"destinationIP": "10.3.3.3", "sourceIP": "10.4.4.4",
+                  "octetDeltaCount": 10, "packetDeltaCount": 1}]
+
+    # valid row then a malformed one: decode fails AFTER minting codes
+    bad = (encode_tsv(ColumnarBatch.from_rows(good_rows, FLOW_SCHEMA))
+           .rstrip(b"\n") + b"\nnot-a-number\t" + b"0\t" * 50 + b"x\n")
+    try:
+        _post_raw("/ingest?stream=s1", bad)
+        assert False, "expected 400"
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+    # stream was reset: a fresh producer stream works immediately
+    enc = BlockEncoder()
+    batch = ColumnarBatch.from_rows(good_rows * 3, FLOW_SCHEMA,
+                                    enc.dicts)
+    out = _post_raw("/ingest?stream=s1", enc.encode(batch))
+    assert out["rows"] == 3
+
+    # hostile Content-Length values are rejected, not hung on
+    for cl in ("-1", "999999999999"):
+        try:
+            _post_raw("/ingest?stream=s2", b"x",
+                      headers={"Content-Length": cl})
+            assert False, "expected 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+        except urllib.error.URLError:
+            pass   # some client stacks refuse to send bogus lengths

@@ -154,10 +154,11 @@ class SupportBundleManager:
 
 
 class ManagerAPIHandler(BaseHTTPRequestHandler):
-    server_version = "theia-tpu-manager/0.2"
+    server_version = "theia-tpu-manager/0.3"
     controller: JobController
     stats: StatsProvider
     bundles: SupportBundleManager
+    ingest = None   # IngestManager
     quiet = True
 
     def log_message(self, fmt, *args):  # noqa: N802
@@ -179,11 +180,32 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
         self._send_json({"kind": "Status", "status": "Failure",
                          "message": message, "code": code}, code)
 
+    # 256 MiB: bounds what one request can make the server buffer.
+    MAX_BODY_BYTES = 256 << 20
+
+    def _read_raw_body(self) -> bytes:
+        """Validated request body (Content-Length must be a sane
+        non-negative size — a negative value would make read() block
+        until the client hangs up, holding the worker thread)."""
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+        except ValueError:
+            raise ValueError("invalid Content-Length")
+        if length < 0 or length > self.MAX_BODY_BYTES:
+            raise ValueError(
+                f"Content-Length {length} outside "
+                f"[0, {self.MAX_BODY_BYTES}]")
+        return self.rfile.read(length) if length else b""
+
     def _read_body(self) -> Dict[str, object]:
-        length = int(self.headers.get("Content-Length", 0))
-        if not length:
-            return {}
-        return json.loads(self.rfile.read(length))
+        raw = self._read_raw_body()
+        return json.loads(raw) if raw else {}
+
+    def _query(self) -> Dict[str, str]:
+        import urllib.parse
+        q = urllib.parse.parse_qs(
+            urllib.parse.urlsplit(self.path).query)
+        return {k: v[0] for k, v in q.items()}
 
     def _route(self) -> Tuple[str, ...]:
         return tuple(p for p in self.path.split("?")[0].split("/") if p)
@@ -224,6 +246,12 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
 
     def _get(self) -> None:
         parts = self._route()
+        if parts == ("alerts",):
+            limit = int(self._query().get("limit", "100"))
+            self._send_json(
+                {"alerts": self.ingest.recent_alerts(limit),
+                 "rowsIngested": self.ingest.rows_ingested})
+            return
         if parts == ("healthz",):
             self._send_json({"status": "ok"})
             return
@@ -251,15 +279,13 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
         underlying JSON data (the Grafana-datasource equivalent of the
         reference's read path; start/end play the $__timeFilter role)."""
         import inspect
-        import urllib.parse
 
         from ..dashboards import DASHBOARDS, render
         if len(parts) >= 3 and parts[1] == "api":
             fn = DASHBOARDS[parts[2]]
-            qs = urllib.parse.parse_qs(
-                urllib.parse.urlsplit(self.path).query)
+            qs = self._query()
             accepted = inspect.signature(fn).parameters
-            kwargs = {name: int(qs[name][0]) for name
+            kwargs = {name: int(qs[name]) for name
                       in ("start", "end", "limit", "k")
                       if name in qs and name in accepted}
             self._send_json({"dashboard": parts[2],
@@ -339,6 +365,13 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
 
     def _post(self) -> None:
         parts = self._route()
+        if parts == ("ingest",):
+            stream = self._query().get("stream", "default")
+            payload = self._read_raw_body()
+            if not payload:
+                raise ValueError("empty ingest payload")
+            self._send_json(self.ingest.ingest(payload, stream=stream))
+            return
         if self.path.startswith(GROUP_INTELLIGENCE) and len(parts) == 4:
             kind = _RESOURCE_KIND[parts[3]]
             body = self._read_body()
@@ -396,14 +429,17 @@ class TheiaManagerServer:
                  tls_cert: Optional[str] = None,
                  tls_key: Optional[str] = None,
                  tls_ca: Optional[str] = None) -> None:
+        from .ingest import IngestManager
         self.controller = JobController(db, workers=workers)
         self.stats = StatsProvider(db, capacity_bytes=capacity_bytes)
         self.bundles = SupportBundleManager(self.controller, self.stats)
+        self.ingest = IngestManager(db)
 
         handler = type("BoundHandler", (ManagerAPIHandler,), {
             "controller": self.controller,
             "stats": self.stats,
             "bundles": self.bundles,
+            "ingest": self.ingest,
         })
         self.httpd = _TLSCapableServer((address, port), handler)
         self.ca_cert_path: Optional[str] = None
