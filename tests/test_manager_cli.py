@@ -296,3 +296,44 @@ def test_ingest_stream_resets_on_failure(server):
             assert e.code == 400
         except urllib.error.URLError:
             pass   # some client stacks refuse to send bogus lengths
+
+
+def test_alert_keys_stable_across_streams(server):
+    """A flood split across two producer streams must still aggregate
+    to ONE heavy-hitter key: detector keys are re-encoded against an
+    ingest-global dictionary, not stream-local codes (which alias and
+    split across streams/resets)."""
+    from theia_tpu.ingest import BlockEncoder
+    from theia_tpu.schema import FLOW_SCHEMA, ColumnarBatch
+
+    def _post_raw(path, payload):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}{path}", method="POST",
+            data=payload)
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return json.loads(r.read())
+
+    def _batch(enc, dst, n, octets, salt):
+        # distinct per-stream junk strings first, so the victim's
+        # stream-local code differs between the two encoders
+        rows = [{"destinationIP": f"10.55.{salt}.{i % 9}",
+                 "sourceIP": f"10.56.{salt}.{i % 7}",
+                 "octetDeltaCount": 10, "packetDeltaCount": 1}
+                for i in range(5)]
+        rows += [{"destinationIP": dst, "sourceIP": f"10.57.{salt}.{i % 89}",
+                  "octetDeltaCount": octets, "packetDeltaCount": 9}
+                 for i in range(n)]
+        return enc.encode(ColumnarBatch.from_rows(rows, FLOW_SCHEMA,
+                                                  enc.dicts))
+
+    enc_a, enc_b = BlockEncoder(), BlockEncoder()
+    _post_raw("/ingest?stream=east", _batch(enc_a, "10.77.77.77", 30,
+                                            400_000, 1))
+    _post_raw("/ingest?stream=west", _batch(enc_b, "10.77.77.77", 30,
+                                            400_000, 2))
+    doc = _get(server, "/alerts?limit=200")
+    hh = [a for a in doc["alerts"] if a["kind"] == "heavy_hitter"
+          and a["destination"] == "10.77.77.77"]
+    assert hh, "cross-stream flood must surface as one heavy hitter"
+    # the estimate must reflect BOTH streams' volume
+    assert max(a["estimate"] for a in hh) >= 0.8 * 60 * 400_000

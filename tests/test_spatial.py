@@ -1,0 +1,73 @@
+"""Spatial DBSCAN over flow embeddings (north-star config 3)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from theia_tpu.analytics.spatial import flow_embeddings, spatial_outliers
+from theia_tpu.ops.dbscan import dbscan_points_noise
+from theia_tpu.schema import FLOW_SCHEMA, ColumnarBatch
+
+
+def test_points_noise_matches_brute_force():
+    rng = np.random.default_rng(0)
+    pts = np.concatenate([
+        rng.normal(0, 0.3, (200, 4)),
+        rng.normal(10, 0.3, (150, 4)),
+        rng.uniform(-50, 50, (10, 4)),
+    ]).astype(np.float32)
+    valid = np.ones(len(pts), bool)
+    got = np.asarray(dbscan_points_noise(
+        jnp.asarray(pts), jnp.asarray(valid), eps=2.0, min_samples=4,
+        block=64))
+    d2 = ((pts[:, None, :] - pts[None, :, :]) ** 2).sum(-1)
+    within = d2 <= 4.0
+    core = within.sum(-1) >= 4
+    ref = ~core & ~(within & core[None, :]).any(-1)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_padding_and_validity_mask():
+    pts = np.zeros((5, 4), np.float32)   # 5 identical points
+    valid = np.asarray([True] * 3 + [False] * 2)
+    # only 3 valid points < min_samples=4 -> all valid points are noise
+    noise = np.asarray(dbscan_points_noise(
+        jnp.asarray(pts), jnp.asarray(valid), eps=1.0, min_samples=4,
+        block=4))
+    np.testing.assert_array_equal(noise, [True] * 3 + [False] * 2)
+
+
+def test_one_off_flows_are_spatial_outliers():
+    rows = []
+    # recurring patterns: two services, many observations each
+    for i in range(40):
+        rows.append({"sourceIP": "10.0.0.1", "destinationIP": "10.0.1.1",
+                     "destinationTransportPort": 5432,
+                     "octetDeltaCount": 5000 + (i % 7) * 10})
+        rows.append({"sourceIP": "10.0.0.2", "destinationIP": "10.0.1.2",
+                     "destinationTransportPort": 443,
+                     "octetDeltaCount": 800 + (i % 5) * 5})
+    # one-off probes: unique (src, dst, port) combos
+    rows.append({"sourceIP": "172.16.9.9", "destinationIP": "10.0.1.1",
+                 "destinationTransportPort": 22,
+                 "octetDeltaCount": 120})
+    rows.append({"sourceIP": "172.16.9.9", "destinationIP": "10.0.1.2",
+                 "destinationTransportPort": 3389,
+                 "octetDeltaCount": 95})
+    batch = ColumnarBatch.from_rows(rows, FLOW_SCHEMA)
+    out = spatial_outliers(batch)
+    got = {(o["sourceIP"], o["destinationTransportPort"]) for o in out}
+    assert got == {("172.16.9.9", 22), ("172.16.9.9", 3389)}
+
+
+def test_embedding_shape_and_determinism():
+    rows = [{"sourceIP": "1.2.3.4", "destinationIP": "5.6.7.8",
+             "destinationTransportPort": 80, "octetDeltaCount": 1000}]
+    b = ColumnarBatch.from_rows(rows, FLOW_SCHEMA)
+    e1, e2 = flow_embeddings(b), flow_embeddings(b)
+    assert e1.shape == (1, 4)
+    np.testing.assert_array_equal(e1, e2)
+    assert spatial_outliers(ColumnarBatch.from_rows([], FLOW_SCHEMA)) \
+        == []

@@ -6,6 +6,7 @@ from .heavy_hitters import HeavyHitterAlert, HeavyHitterDetector
 from .itemsets import mine_frequent_patterns
 from .npr import (NAMESPACE_ALLOW_LIST, read_distinct_flows, run_npr)
 from .series import SeriesBatch, TadQuerySpec, build_series
+from .spatial import flow_embeddings, spatial_outliers
 from .streaming import StreamingDetector, stream_update
 from .tad import ALGORITHMS, detect_anomalies, run_tad, score_series
 
@@ -17,4 +18,5 @@ __all__ = [
     "run_drop_detection",
     "HeavyHitterAlert", "HeavyHitterDetector",
     "mine_frequent_patterns",
+    "flow_embeddings", "spatial_outliers",
 ]

@@ -160,6 +160,10 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
     bundles: SupportBundleManager
     ingest = None   # IngestManager
     quiet = True
+    # Socket timeout (StreamRequestHandler honors it): a client that
+    # declares a Content-Length then stalls mid-body would otherwise
+    # hold a worker thread forever (slow-loris).
+    timeout = 120
 
     def log_message(self, fmt, *args):  # noqa: N802
         logger.v(2).info("%s %s", self.address_string(), fmt % args)

@@ -29,8 +29,11 @@ import threading
 import time
 from typing import Deque, Dict, List, Optional
 
+import numpy as np
+
 from ..analytics.heavy_hitters import HeavyHitterDetector
 from ..ingest.native import BLOCK_MAGIC, BLOCK_MAGIC_V1, TsvDecoder
+from ..schema import ColumnarBatch, StringDictionary
 from ..utils import get_logger
 
 logger = get_logger("ingest")
@@ -67,29 +70,49 @@ class IngestManager:
     into the store's dictionaries on insert (Table adoption), so
     streams never need to know store state."""
 
+    #: streams idle longer than this may be evicted to admit new ones
+    IDLE_EVICT_SECONDS = 300.0
+
     def __init__(self, db, detector: Optional[HeavyHitterDetector] = None
                  ) -> None:
         self.db = db
         self._streams: Dict[str, _Stream] = {}
         self._registry_lock = threading.Lock()
         self.detector = detector or HeavyHitterDetector()
-        # Detector state + alert ring share one short-held lock so
-        # GET /alerts never waits behind a decoding batch.
+        # Detector state (device compute) and the alert ring have
+        # separate locks: GET /alerts only touches the cheap ring lock,
+        # never waiting behind scoring or JIT compilation.
         self._detector_lock = threading.Lock()
+        self._alerts_lock = threading.Lock()
         self._alerts: Deque[Dict[str, object]] = collections.deque(
             maxlen=MAX_ALERTS)
         self.rows_ingested = 0
+        # Detector keys must be stable across streams and stream
+        # resets; stream-local dictionary codes are neither, so
+        # destinations re-encode against this ingest-global dictionary
+        # before scoring.
+        self._dst_dict = StringDictionary()
 
     def _stream(self, stream_id: str) -> _Stream:
         with self._registry_lock:
             st = self._streams.get(stream_id)
             if st is None:
                 if len(self._streams) >= MAX_STREAMS:
-                    lru = min(self._streams,
-                              key=lambda s: self._streams[s].last_used)
-                    del self._streams[lru]
+                    # Only genuinely idle streams are evictable —
+                    # evicting an active producer would break its delta
+                    # chain on every block (reset thrash).
+                    now = time.monotonic()
+                    idle = [s for s, v in self._streams.items()
+                            if now - v.last_used > self.IDLE_EVICT_SECONDS]
+                    if not idle:
+                        raise ValueError(
+                            f"too many active ingest streams "
+                            f"(max {MAX_STREAMS})")
+                    victim = min(idle,
+                                 key=lambda s: self._streams[s].last_used)
+                    del self._streams[victim]
                     logger.v(1).info("evicted idle ingest stream %r",
-                                     lru)
+                                     victim)
                 st = self._streams[stream_id] = _Stream()
                 logger.v(1).info("new ingest stream %r", stream_id)
             st.last_used = time.monotonic()
@@ -120,8 +143,18 @@ class IngestManager:
                 raise
             n = self.db.insert_flows(batch)
         with self._detector_lock:
-            alerts = self.detector.update(batch)
-            now = time.time()
+            # Re-encode destinations against the ingest-global
+            # dictionary: CMS keys persist across batches, so they must
+            # mean the same destination whichever stream (or stream
+            # generation) produced the batch.
+            gcodes = self._dst_dict.encode(
+                list(batch.strings("destinationIP"))).astype(np.int32)
+            scored = ColumnarBatch(
+                {**batch.columns, "destinationIP": gcodes},
+                {**batch.dicts, "destinationIP": self._dst_dict})
+            alerts = self.detector.update(scored)
+        now = time.time()
+        with self._alerts_lock:
             for a in alerts:
                 self._alerts.appendleft(
                     {**dataclasses.asdict(a), "time": now})
@@ -132,5 +165,5 @@ class IngestManager:
         return {"rows": n, "alerts": len(alerts)}
 
     def recent_alerts(self, limit: int = 100) -> List[Dict[str, object]]:
-        with self._detector_lock:
+        with self._alerts_lock:
             return list(self._alerts)[:max(limit, 0)]

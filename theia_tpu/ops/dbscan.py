@@ -110,3 +110,63 @@ def dbscan_scores(x: jnp.ndarray, mask: jnp.ndarray,
     calc = jnp.zeros_like(x)
     std = masked_stddev_samp(x, mask)
     return calc, std, anomaly
+
+
+# -- spatial DBSCAN over [N, F] point embeddings ------------------------
+#
+# The BASELINE north-star config 3 generalization: "DBSCAN spatial
+# anomaly on (srcIP, dstIP, dstPort, bytes) embeddings". Same
+# closed-form noise test as the per-series kernel, over euclidean
+# distance in feature space, computed in [block, N] tiles so the full
+# [N, N] distance matrix never materializes: two lax.scan passes
+# (neighbor counts, then core-reachability), each tile one
+# matmul-shaped distance evaluation on the MXU.
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "min_samples",
+                                             "block"))
+def dbscan_points_noise(points: jnp.ndarray, valid: jnp.ndarray,
+                        eps: float, min_samples: int = DEFAULT_MIN_SAMPLES,
+                        block: int = 1024) -> jnp.ndarray:
+    """Noise flags for [N, F] float points (`valid` masks padding).
+    Exact O(N^2) pairwise computation, O(N*block) memory."""
+    points = points.astype(jnp.float32)
+    n = points.shape[0]
+    pad = (-n) % block
+    if pad:
+        points = jnp.concatenate(
+            [points, jnp.zeros((pad, points.shape[1]), jnp.float32)])
+        valid = jnp.concatenate([valid, jnp.zeros(pad, bool)])
+    nb = points.shape[0] // block
+    tiles = points.reshape(nb, block, -1)
+    tile_valid = valid.reshape(nb, block)
+    eps2 = eps * eps
+    x2 = (points * points).sum(-1)
+
+    def within(tile):             # [block, F] -> [block, Npad] bool
+        t2 = (tile * tile).sum(-1)
+        # HIGHEST precision: the default TPU bf16 matmul's absolute
+        # error (~0.4% of the ~scale^2 dot products) would swamp eps^2
+        # and corrupt the threshold test.
+        d2 = t2[:, None] + x2[None, :] - 2.0 * jnp.matmul(
+            tile, points.T, precision=jax.lax.Precision.HIGHEST)
+        return d2 <= eps2
+
+    def count_pass(_, tv):
+        tile, tvalid = tv
+        w = within(tile) & valid[None, :] & tvalid[:, None]
+        return None, w.sum(-1)
+
+    _, counts = jax.lax.scan(count_pass, None, (tiles, tile_valid))
+    counts = counts.reshape(-1)
+    core = (counts >= min_samples) & valid
+
+    def reach_pass(_, tv):
+        tile, tvalid = tv
+        w = within(tile) & core[None, :] & tvalid[:, None]
+        return None, w.any(-1)
+
+    _, reachable = jax.lax.scan(reach_pass, None, (tiles, tile_valid))
+    reachable = reachable.reshape(-1)
+    noise = valid & ~core & ~reachable
+    return noise[:n]

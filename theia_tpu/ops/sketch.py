@@ -123,10 +123,13 @@ def kmeans_step(state: KMeansState, points: jnp.ndarray,
     if valid is None:
         valid = jnp.ones(points.shape[0], bool)
     vf = valid.astype(jnp.float32)
-    # [N, K] squared distances as matmul-shaped work (MXU-friendly).
+    # [N, K] squared distances as matmul-shaped work (MXU-friendly);
+    # full precision so small inter-centroid gaps survive on TPU.
     x2 = (points * points).sum(-1, keepdims=True)
     c2 = (state.centroids * state.centroids).sum(-1)
-    d2 = x2 + c2[None, :] - 2.0 * points @ state.centroids.T
+    d2 = x2 + c2[None, :] - 2.0 * jnp.matmul(
+        points, state.centroids.T,
+        precision=jax.lax.Precision.HIGHEST)
     assign = jnp.argmin(d2, axis=1)
     dist = jnp.sqrt(jnp.maximum(
         jnp.take_along_axis(d2, assign[:, None], axis=1)[:, 0], 0.0))
