@@ -67,7 +67,7 @@ def test_embedding_shape_and_determinism():
              "destinationTransportPort": 80, "octetDeltaCount": 1000}]
     b = ColumnarBatch.from_rows(rows, FLOW_SCHEMA)
     e1, e2 = flow_embeddings(b), flow_embeddings(b)
-    assert e1.shape == (1, 4)
+    assert e1.shape == (1, 7)
     np.testing.assert_array_equal(e1, e2)
     assert spatial_outliers(ColumnarBatch.from_rows([], FLOW_SCHEMA)) \
         == []

@@ -26,15 +26,21 @@ from ..ops.dbscan import dbscan_points_noise
 from ..schema import ColumnarBatch
 
 # Categorical axes are scaled so ANY identity mismatch dominates a
-# volume difference: hash01 in [0, SCALE) with SCALE >> eps.
+# volume difference: hash coordinates in [0, SCALE) with SCALE >> eps.
+# Each identity gets TWO independent hash coordinates: a single axis
+# collides two distinct identities with probability ~2·eps/SCALE (~2%),
+# which would silently merge clusters; two axes square that to ~1e-4.
+# (f32 d² cancellation caps SCALE itself at ~1e2 for eps=1.)
 CATEGORICAL_SCALE = 100.0
 DEFAULT_EPS = 1.0
 DEFAULT_MIN_SAMPLES = 4
 
+EMBED_DIM = 7   # 2 src + 2 dst + 2 port + volume
 
-def _hash01(codes: np.ndarray) -> np.ndarray:
+
+def _hash01(codes: np.ndarray, seed: int) -> np.ndarray:
     """Integer codes → deterministic pseudo-random floats in [0, 1)."""
-    h = codes.astype(np.uint32)
+    h = codes.astype(np.uint32) ^ np.uint32(seed)
     h ^= h >> 16
     h = (h * np.uint32(0x85EBCA6B)) & np.uint32(0xFFFFFFFF)
     h ^= h >> 13
@@ -44,15 +50,16 @@ def _hash01(codes: np.ndarray) -> np.ndarray:
 
 
 def flow_embeddings(flows: ColumnarBatch) -> np.ndarray:
-    """[n, 4] float32 (src, dst, port, log-bytes) embedding."""
-    src = _hash01(np.asarray(flows["sourceIP"], np.int64))
-    dst = _hash01(np.asarray(flows["destinationIP"], np.int64))
-    port = _hash01(np.asarray(flows["destinationTransportPort"],
-                              np.int64))
-    vol = np.log1p(np.asarray(flows["octetDeltaCount"], np.float64))
-    return np.stack([src * CATEGORICAL_SCALE, dst * CATEGORICAL_SCALE,
-                     port * CATEGORICAL_SCALE, vol],
-                    axis=1).astype(np.float32)
+    """[n, 7] float32 (src×2, dst×2, port×2, log-bytes) embedding."""
+    axes = []
+    for col in ("sourceIP", "destinationIP",
+                "destinationTransportPort"):
+        codes = np.asarray(flows[col], np.int64)
+        for seed in (0x1234ABCD, 0x9E3779B9):
+            axes.append(_hash01(codes, seed) * CATEGORICAL_SCALE)
+    axes.append(np.log1p(
+        np.asarray(flows["octetDeltaCount"], np.float64)))
+    return np.stack(axes, axis=1).astype(np.float32)
 
 
 def spatial_outliers(flows: ColumnarBatch,

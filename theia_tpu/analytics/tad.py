@@ -35,7 +35,12 @@ def score_series(values: np.ndarray, mask: np.ndarray, algo: str):
     if algo == "EWMA":
         calc, std, anom = ewma_scores(values, mask)
     elif algo == "ARIMA":
-        calc, std, anom = arima_scores(values, mask)
+        # Exact refit-per-step (reference semantics) up to moderate
+        # lengths; beyond that, group refits so 24h@1s-scale series
+        # stay feasible (see ops/arima.arima_walk_forward).
+        refit = max(1, values.shape[1] // 2048)
+        calc, std, anom = arima_scores(values, mask,
+                                       refit_every=refit)
     elif algo == "DBSCAN":
         calc, std, anom = dbscan_scores(values, mask)
     else:

@@ -227,10 +227,14 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
             self._send_error_json(500, f"{type(e).__name__}: {e}")
 
     def do_POST(self) -> None:  # noqa: N802
+        from .ingest import StreamCapacityError
         try:
             self._post()
         except DuplicateJobError as e:
             self._send_error_json(409, str(e))
+        except StreamCapacityError as e:
+            # retryable capacity condition, not a client payload error
+            self._send_error_json(503, str(e))
         except KeyError:
             self._send_error_json(404, f"not found: {self.path}")
         except (ValueError, json.JSONDecodeError) as e:

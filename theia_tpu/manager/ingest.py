@@ -44,6 +44,11 @@ MAX_ALERTS = 1000
 MAX_STREAMS = 64
 
 
+class StreamCapacityError(Exception):
+    """All stream slots are held by active producers (→ HTTP 503:
+    retryable capacity condition, not a payload error)."""
+
+
 class _Stream:
     def __init__(self) -> None:
         self.decoder = TsvDecoder()
@@ -64,11 +69,14 @@ class IngestManager:
     Failure/lifetime semantics (again mirroring a native-protocol
     connection): a payload that fails to decode RESETS the stream (the
     decoder is discarded — a partially-applied decode would otherwise
-    desync the dictionary chain for good), and when the stream table is
-    full the least-recently-used stream is evicted; in both cases the
-    producer restarts with a fresh encoder. Decoded batches re-encode
-    into the store's dictionaries on insert (Table adoption), so
-    streams never need to know store state."""
+    desync the dictionary chain for good) and the producer restarts
+    with a fresh encoder. When the stream table is full, only a stream
+    idle for > IDLE_EVICT_SECONDS is evicted to admit the new one;
+    with MAX_STREAMS active producers a new stream is refused with
+    StreamCapacityError (HTTP 503, retryable) rather than breaking an
+    active producer's delta chain. Decoded batches re-encode into the
+    store's dictionaries on insert (Table adoption), so streams never
+    need to know store state."""
 
     #: streams idle longer than this may be evicted to admit new ones
     IDLE_EVICT_SECONDS = 300.0
@@ -105,7 +113,7 @@ class IngestManager:
                     idle = [s for s, v in self._streams.items()
                             if now - v.last_used > self.IDLE_EVICT_SECONDS]
                     if not idle:
-                        raise ValueError(
+                        raise StreamCapacityError(
                             f"too many active ingest streams "
                             f"(max {MAX_STREAMS})")
                     victim = min(idle,

@@ -130,60 +130,85 @@ def _fit_prefix(d: jnp.ndarray, w: jnp.ndarray):
             jnp.clip(theta, -_CLIP, _CLIP))
 
 
-def _forecast_one(y: jnp.ndarray, m: jnp.ndarray):
-    """One-step forecast ŷ_m from history y[:m] (m ≥ 3), one series.
-
-    y: [T] Box-Cox values. Differences d_t = y_{t+1} − y_t live at
-    indices 0..T-2; the prefix uses d[0:m-1].
-    """
-    T = y.shape[0]
-    d = y[1:] - y[:-1]
-    idx = jnp.arange(T - 1)
-    w = (idx < (m - 1)).astype(y.dtype)
-    phi, theta = _fit_prefix(d, w)
-
-    # CSS residual recursion over the prefix: eps_t = d_t − φ d_{t-1}
-    # − θ eps_{t-1} (eps conditioned to 0 at t=0), then forecast
-    # d̂ = φ·d_{m-2} + θ·eps_{m-2}.
-    def step(eps_prev, t):
-        d_prev = jnp.where(t >= 1, d[jnp.maximum(t - 1, 0)], 0.0)
-        eps_t = d[t] - phi * d_prev - theta * eps_prev
-        eps_t = jnp.where((t >= 1) & (t < m - 1), eps_t, eps_prev)
-        eps_t = jnp.where(t == 0, 0.0, eps_t)
-        return eps_t, eps_t
-
-    eps_last, _ = jax.lax.scan(step, jnp.array(0.0, y.dtype), idx)
-    d_last = d[jnp.maximum(m - 2, 0)]
-    d_hat = phi * d_last + theta * eps_last
-    return y[jnp.maximum(m - 1, 0)] + d_hat
-
-
-@jax.jit
-def arima_walk_forward(y: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+@functools.partial(jax.jit,
+                   static_argnames=("refit_every", "group_chunk"))
+def arima_walk_forward(y: jnp.ndarray, mask: jnp.ndarray,
+                       refit_every: int = 1,
+                       group_chunk: int = 512) -> jnp.ndarray:
     """Walk-forward one-step forecasts for a padded [S, T] Box-Cox batch.
 
     pred[:, :3] = y[:, :3] (the reference's train prefix is passed
-    through, :241-255); pred[:, m] for m ≥ 3 comes from a fit on y[:, :m].
-    All (series, prefix) fits run in parallel.
+    through, :241-255); pred[:, m] for m ≥ 3 comes from a fit on a
+    prefix of y.
+
+    `refit_every=k` groups prefixes: the fit for steps [g·k, (g+1)·k)
+    uses the prefix of length max(g·k, 3), and one CSS residual
+    recursion per group serves all its steps — k=1 is the reference's
+    exact refit-per-step semantics; k>1 trades refit freshness for a
+    k× compute cut on long series (the 24h@1s scale where per-step
+    refits are infeasible for any implementation). Groups evaluate in
+    `group_chunk`-sized chunks via lax.map, so peak memory is
+    O(S · group_chunk · T) instead of the O(S · T²) a full vmap over
+    prefixes would materialize.
     """
     S, T = y.shape
-    ms = jnp.arange(T)
+    k = refit_every
+    n_groups = -(-T // k)
+    y0 = jnp.where(mask, y, 0.0)
 
     def per_series(y_row):
-        preds = jax.vmap(lambda m: _forecast_one(y_row, m))(ms)
-        return jnp.where(ms < 3, y_row, preds)
+        d = y_row[1:] - y_row[:-1]            # [T-1]
+        idx = jnp.arange(T - 1)
 
-    preds = jax.vmap(per_series)(jnp.where(mask, y, 0.0))
-    return preds
+        def group_preds(g):
+            # Fit on the prefix available at the group's first step;
+            # CSS recursion eps_t = d_t − φ d_{t-1} − θ eps_{t-1}
+            # (eps_0 = 0) runs once with the group's params — eps_t for
+            # t < m−1 doesn't depend on the prefix cutoff, so each step
+            # m just reads eps[m−2].
+            m_fit = jnp.maximum(g * k, 3)
+            w = (idx < (m_fit - 1)).astype(y_row.dtype)
+            phi, theta = _fit_prefix(d, w)
+
+            def step(eps_prev, t):
+                d_prev = jnp.where(t >= 1, d[jnp.maximum(t - 1, 0)],
+                                   0.0)
+                eps_t = d[t] - phi * d_prev - theta * eps_prev
+                eps_t = jnp.where(t == 0, 0.0, eps_t)
+                return eps_t, eps_t
+
+            _, eps = jax.lax.scan(step, jnp.array(0.0, y_row.dtype),
+                                  idx)
+            ms = g * k + jnp.arange(k)
+            last = jnp.clip(ms - 2, 0, T - 2)
+            d_hat = phi * d[last] + theta * eps[last]
+            return y_row[jnp.clip(ms - 1, 0, T - 1)] + d_hat
+
+        gs = jnp.arange(n_groups)
+        if n_groups <= group_chunk:
+            preds = jax.vmap(group_preds)(gs).reshape(-1)[:T]
+        else:
+            pad = (-n_groups) % group_chunk
+            gs = jnp.concatenate([gs, jnp.zeros(pad, gs.dtype)])
+            preds = jax.lax.map(
+                jax.vmap(group_preds),
+                gs.reshape(-1, group_chunk)).reshape(-1)[:T]
+        ms_all = jnp.arange(T)
+        return jnp.where(ms_all < 3, y_row, preds)
+
+    return jax.vmap(per_series)(y0)
 
 
-@jax.jit
-def arima_scores(x: jnp.ndarray, mask: jnp.ndarray):
+@functools.partial(jax.jit, static_argnames=("refit_every",))
+def arima_scores(x: jnp.ndarray, mask: jnp.ndarray,
+                 refit_every: int = 1):
     """Full ARIMA scoring: (pred levels [S,T], stddev [S], anomaly [S,T]).
 
     Series with ≤ 3 points or any non-positive value produce no anomalies
     and zero algoCalc, matching the reference's error paths (:232-234,
-    :260-264: scipy.boxcox raises on x ≤ 0 → caught → None → [False])."""
+    :260-264: scipy.boxcox raises on x ≤ 0 → caught → None → [False]).
+    `refit_every` (see arima_walk_forward) defaults to the reference's
+    exact refit-per-step; long-series callers raise it."""
     n = masked_count(mask)
     positive = jnp.all(jnp.where(mask, x > 0, True), axis=-1)
     ok = (n >= MIN_POINTS) & positive
@@ -203,7 +228,13 @@ def arima_scores(x: jnp.ndarray, mask: jnp.ndarray):
 
     lam = boxcox_lambda(xs, mask)
     y = boxcox_transform(xs, lam)
-    preds_bc = arima_walk_forward(y, mask)
+    # Auto-size the group chunk: each chunk materializes an
+    # [S, chunk, T] f32 eps stack — budget it at ~256 MiB so 24h@1s
+    # series fit alongside the rest of the working set.
+    S, T = x.shape
+    chunk = max(1, min(512, (256 << 20) // max(1, 4 * S * T)))
+    preds_bc = arima_walk_forward(y, mask, refit_every=refit_every,
+                                  group_chunk=chunk)
     preds = inv_boxcox(preds_bc, lam) * gm
     preds = jnp.where(ok[..., None] & mask, preds, 0.0)
 
