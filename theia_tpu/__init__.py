@@ -12,9 +12,15 @@ Subpackages:
               monitor, save/load persistence
   data      — synthetic Antrea flow generator (benchmarks + tests)
   ops       — on-device kernels: EWMA/ARIMA/DBSCAN anomaly scoring,
-              masked segment/series statistics
-  analytics — the TAD and NPR jobs (reference: plugins/anomaly-detection,
-              plugins/policy-recommendation)
+              masked series statistics, Count-Min-Sketch + online
+              k-means, traffic-drop scoring, spatial DBSCAN
+  analytics — the TAD, NPR, and drop-detection jobs (reference:
+              plugins/anomaly-detection, plugins/policy-recommendation,
+              snowflake/udfs drop_detection), plus streaming
+              heavy-hitter/DDoS alerts, frequent-pattern mining, and
+              spatial flow-embedding outliers
+  dashboards — the 8 reference dashboards as server-rendered SVG +
+              JSON data API
   parallel  — device meshes and sharded scoring (shard_map over series)
   runner    — the tpu-job-runner honoring the reference Spark-job CLI
               contract, with progress reporting
