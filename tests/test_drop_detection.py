@@ -201,3 +201,40 @@ def test_sharded_store_drop_detection_and_stats():
     # dropdetection bytes count toward disk usage (non-zero: the store
     # holds both flow rows and one result row)
     assert float(stats.disk_infos()[0]["usedPercentage"]) > 0
+
+
+def test_pod_ip_change_does_not_split_partition():
+    # Reference partitions on the derived endpoint string: a pod whose
+    # IP changes mid-window (restart) stays ONE partition, and an
+    # IP-only endpoint ignores varying namespace codes
+    # (dropDetection.go:131-143 builds ns/pod OR bare IP, never both).
+    db = FlowDatabase()
+    counts = [1] * 14 + [500]
+    rows = []
+    for day, n in enumerate(counts):
+        ip = "10.0.0.2" if day < 7 else "10.0.9.9"   # pod restarted
+        for _ in range(n):
+            rows.append(_drop_row(day, dst=("ns-b", "pod-b", ip),
+                                  ingress_action=2))
+    db.insert_flow_rows(rows)
+    run_drop_detection(db)
+    out = db.dropdetection.scan().to_rows()
+    assert len(out) == 1
+    assert out[0]["endpoint"] == "ns-b/pod-b"
+    assert out[0]["anomalyDropNumber"] == 500
+
+
+def test_ip_endpoint_ignores_namespace():
+    db = FlowDatabase()
+    counts = [1] * 14 + [300]
+    rows = []
+    for day, n in enumerate(counts):
+        ns = "left" if day % 2 else "right"  # stray ns on podless src
+        for _ in range(n):
+            rows.append(_drop_row(day, src=(ns, "", "172.16.0.9"),
+                                  egress_action=2))
+    db.insert_flow_rows(rows)
+    run_drop_detection(db)
+    out = db.dropdetection.scan().to_rows()
+    assert len(out) == 1
+    assert out[0]["endpoint"] == "172.16.0.9"

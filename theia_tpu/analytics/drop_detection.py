@@ -73,6 +73,14 @@ def _dropped_partitions(flows, start_time, end_time, cluster_uuid):
     name = np.where(ing_drop, dst_name, src_name)
     ns = np.where(ing_drop, dst_ns, src_ns)
     ip = np.where(ing_drop, dst_ip, src_ip)
+    # Partition on the derived endpoint exactly as the reference derives
+    # it (dropDetection.go:131-143): when the pod name is set the
+    # endpoint is "ns/pod" (IP ignored — a pod restart that changes the
+    # IP must not split the partition); otherwise it is the bare IP
+    # (namespace ignored). Code 0 is the empty string.
+    has_pod = name != 0
+    ns = np.where(has_pod, ns, 0)
+    ip = np.where(has_pod, 0, ip)
     direction = np.where(ing_drop, 0, 1).astype(np.int64)
     date = col("flowStartSeconds") // SECONDS_PER_DAY
     key = np.stack([name, ns, ip, direction], axis=1)

@@ -102,6 +102,12 @@ def _encode_items(flows: ColumnarBatch, columns: Sequence[str]
     base = 0
     for col in columns:
         codes = np.asarray(flows[col], np.int64)
+        if len(codes) and int(codes.min()) < 0:
+            # A negative sentinel would alias into the previous column's
+            # item-id range and corrupt support counts on decode.
+            raise ValueError(
+                f"column {col!r} contains negative codes; itemset "
+                f"columns must be non-negative dictionary codes")
         n_codes = int(codes.max()) + 1 if len(codes) else 1
         mats.append(codes + base)
         table.extend((col, c) for c in range(n_codes))

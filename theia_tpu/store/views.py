@@ -169,7 +169,11 @@ class ViewTable:
         # Shared with the flows table, so view key codes decode with the
         # same dictionaries.
         self.dicts = dicts
-        self._parts: List[Tuple[np.ndarray, np.ndarray]] = []
+        # Parts are (keys, values, exact). `exact` records whether the
+        # part is known collision-free (native memcmp grouping, or a
+        # read-time lexsort compaction); group_sum_fast parts are not —
+        # a 64-bit row-hash collision can split one key across rows.
+        self._parts: List[Tuple[np.ndarray, np.ndarray, bool]] = []
         self._lock = threading.Lock()
 
     def __len__(self) -> int:
@@ -186,6 +190,7 @@ class ViewTable:
         out = native_group_sum(
             [block[c] for c in self.spec.key_columns],
             [block[c] for c in self.spec.sum_columns])
+        exact = out is not None  # native grouping memcmps full keys
         if out is None:
             keys = np.stack([np.asarray(block[c], np.int64)
                              for c in self.spec.key_columns], axis=1)
@@ -193,7 +198,7 @@ class ViewTable:
                                for c in self.spec.sum_columns], axis=1)
             out = group_sum_fast(keys, values)
         with self._lock:
-            self._parts.append(out)
+            self._parts.append((out[0], out[1], exact))
 
     def _merged(self) -> Tuple[np.ndarray, np.ndarray]:
         with self._lock:
@@ -202,8 +207,11 @@ class ViewTable:
             k = np.zeros((0, len(self.spec.key_columns)), np.int64)
             v = np.zeros((0, len(self.spec.sum_columns)), np.int64)
             return k, v
-        if len(parts) == 1:
-            return parts[0]
+        if len(parts) == 1 and parts[0][2]:
+            return parts[0][0], parts[0][1]
+        # Re-group even a lone inexact part: group_sum_fast may have
+        # split a hash-colliding key into two rows, and scan() promises
+        # exact re-grouping at read time.
         keys = np.concatenate([p[0] for p in parts], axis=0)
         values = np.concatenate([p[1] for p in parts], axis=0)
         gk, gv = group_sum(keys, values)
@@ -211,7 +219,7 @@ class ViewTable:
             # Swap in the compacted part only if no insert raced us.
             if len(self._parts) == len(parts) and \
                     self._parts[-1] is parts[-1]:
-                self._parts = [(gk, gv)]
+                self._parts = [(gk, gv, True)]
         return gk, gv
 
     def compact(self) -> None:
@@ -231,13 +239,13 @@ class ViewTable:
         with self._lock:
             dropped = 0
             new_parts = []
-            for keys, values in self._parts:
+            for keys, values, exact in self._parts:
                 keep = keys[:, ti] >= boundary
                 dropped += int((~keep).sum())
                 if keep.all():
-                    new_parts.append((keys, values))
+                    new_parts.append((keys, values, exact))
                 elif keep.any():
-                    new_parts.append((keys[keep], values[keep]))
+                    new_parts.append((keys[keep], values[keep], exact))
             self._parts = new_parts
         return dropped
 
