@@ -88,3 +88,27 @@ def test_framework_version_map():
     assert schema_version_for("0.1.0") == 1
     assert schema_version_for("0.2.0") == 3
     assert schema_version_for("9.9.9") == CURRENT_SCHEMA_VERSION
+
+
+def test_v5_refit_every_up_down(tmp_path):
+    # v4→v5 adds tadetector.refitEvery sized to the table; down drops it.
+    from theia_tpu.analytics import TadQuerySpec, run_tad
+    db = FlowDatabase()
+    db.insert_flow_rows([{
+        "flowStartSeconds": 100 + i, "flowEndSeconds": 110 + i,
+        "sourceIP": "10.0.0.1", "sourceTransportPort": 1000,
+        "destinationIP": "10.0.0.2", "destinationTransportPort": 80,
+        "protocolIdentifier": 6,
+        "throughput": 1e6 if i != 8 else 9e9, "timeInserted": 100 + i,
+    } for i in range(12)])
+    run_tad(db, "EWMA", TadQuerySpec(), tad_id="x")
+    path = tmp_path / "db.npz"
+    db.save(path)
+    payload = dict(np.load(path, allow_pickle=True))
+    n = len(payload["tadetector/id"])
+    assert n > 0
+    migrate(payload, target=4)
+    assert "tadetector/refitEvery" not in payload
+    migrate(payload, target=5)
+    assert len(payload["tadetector/refitEvery"]) == n
+    assert payload["tadetector/refitEvery"].dtype == np.int64

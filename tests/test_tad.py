@@ -162,3 +162,56 @@ def test_tad_agg_pod_end_to_end():
     assert all(r["aggType"] == "pod" for r in real)
     assert all(r["direction"] in ("inbound", "outbound") for r in real)
     assert all(r["podLabels"].startswith("{") for r in real)
+
+
+def test_refit_every_emitted_in_result_rows():
+    # refitEvery is part of every ARIMA result row so the grouped-refit
+    # approximation is observable (reference semantics are exact
+    # refit-per-step, anomaly_detection.py:246-253).
+    db, batch, cfg = make_db(n_series=4, points_per_series=24,
+                             anomaly_fraction=0.5, anomaly_magnitude=40.0)
+    run_tad(db, "ARIMA", TadQuerySpec(), tad_id="tid")
+    rows = db.tadetector.scan().to_rows()
+    assert rows and all(r["refitEvery"] == 1 for r in rows)
+    # EWMA rows carry 0 (no refit concept).
+    run_tad(db, "EWMA", TadQuerySpec(), tad_id="tid2")
+    rows = [r for r in db.tadetector.scan().to_rows()
+            if r["id"] == "tid2"]
+    assert rows and all(r["refitEvery"] == 0 for r in rows)
+
+
+def test_effective_refit_resolution():
+    from theia_tpu.analytics.tad import effective_refit
+    assert effective_refit("ARIMA", 1, 86400) == 1       # exact default
+    assert effective_refit("ARIMA", 0, 86400) == 42      # auto = T//2048
+    assert effective_refit("ARIMA", 0, 1000) == 1        # auto, short T
+    assert effective_refit("ARIMA", 7, 100) == 7         # explicit
+    assert effective_refit("EWMA", 0, 86400) == 0        # n/a
+    with pytest.raises(ValueError):
+        effective_refit("ARIMA", -1, 100)
+
+
+def test_arima_grouped_refit_accuracy_delta_t4096():
+    # Quantify the auto-cadence approximation at the scale where it
+    # first engages: T=4096 → refit every 2 steps. The approximation
+    # must keep predictions within a small relative envelope of the
+    # exact refit-per-step run and flag the identical anomaly set.
+    from theia_tpu.ops import arima_scores
+    rng = np.random.default_rng(7)
+    T = 4096
+    base = 2e8 + 4e6 * rng.standard_normal((2, T)).cumsum(axis=1)
+    base = np.maximum(base, 1e6)
+    base[0, 1000] *= 8.0   # injected spikes
+    base[1, 3000] *= 8.0
+    mask = np.ones_like(base, bool)
+    exact = [np.asarray(a) for a in arima_scores(base, mask,
+                                                 refit_every=1)]
+    approx = [np.asarray(a) for a in arima_scores(base, mask,
+                                                  refit_every=2)]
+    # Identical anomaly sets.
+    np.testing.assert_array_equal(exact[2], approx[2])
+    # Prediction deltas stay tiny relative to the series level (the
+    # stale fit is at most 1 step old).
+    rel = np.abs(exact[0] - approx[0]) / np.abs(base)
+    assert float(np.median(rel)) < 1e-3
+    assert float(np.quantile(rel, 0.99)) < 0.05

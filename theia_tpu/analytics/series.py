@@ -57,6 +57,13 @@ class TadQuerySpec:
     # (rows carry the emitting cluster's UUID, test/e2e_mc). Empty =
     # all clusters, like the reference job's unfiltered SQL.
     cluster_uuid: str = ""
+    # ARIMA refit cadence: 1 = the reference's exact refit-per-step
+    # (anomaly_detection.py:246-253), k>1 = grouped refits (fit reused
+    # for k consecutive steps, a k× compute cut on long series), 0 =
+    # auto (max(1, T // 2048), sized so 24h@1s series stay feasible).
+    # Ignored by EWMA/DBSCAN. The effective value is emitted in every
+    # ARIMA result row as `refitEvery`.
+    refit_every: int = 1
 
     @property
     def agg_type(self) -> str:

@@ -22,23 +22,49 @@ import numpy as np
 
 from ..ops import arima_scores, dbscan_scores, ewma_scores
 from ..store import FlowDatabase
+from ..utils import get_logger
 from .series import SeriesBatch, TadQuerySpec, build_series
+
+logger = get_logger("tad")
 
 ALGORITHMS = ("EWMA", "ARIMA", "DBSCAN")
 
 
-def score_series(values: np.ndarray, mask: np.ndarray, algo: str):
+def effective_refit(algo: str, refit_every: int, n_steps: int) -> int:
+    """Resolve the ARIMA refit cadence a job will actually run with.
+
+    refit_every=1 is the reference's exact refit-per-step
+    (anomaly_detection.py:246-253); 0 selects the auto heuristic
+    max(1, T // 2048) that keeps 24h@1s series feasible. Non-ARIMA
+    algorithms have no refit concept → 0."""
+    if algo != "ARIMA":
+        return 0
+    if refit_every < 0:
+        raise ValueError(f"refitEvery must be >= 0, got {refit_every}")
+    return refit_every if refit_every else max(1, n_steps // 2048)
+
+
+def score_series(values: np.ndarray, mask: np.ndarray, algo: str,
+                 refit_every: int = 1):
     """Run one algorithm over a padded [S, T] batch.
 
     Returns (algo_calc [S,T], stddev [S], anomaly [S,T]) as numpy.
+    `refit_every` applies to ARIMA only (see `effective_refit`).
     """
     if algo == "EWMA":
         calc, std, anom = ewma_scores(values, mask)
     elif algo == "ARIMA":
-        # Exact refit-per-step (reference semantics) up to moderate
-        # lengths; beyond that, group refits so 24h@1s-scale series
-        # stay feasible (see ops/arima.arima_walk_forward).
-        refit = max(1, values.shape[1] // 2048)
+        refit = effective_refit(algo, refit_every, values.shape[1])
+        if refit > 1:
+            logger.info(
+                "ARIMA grouped-refit approximation active: refitting "
+                "every %d steps over T=%d (reference-exact is "
+                "refitEvery=1)", refit, values.shape[1])
+        elif values.shape[1] > 8192:
+            logger.warning(
+                "ARIMA exact refit-per-step over T=%d steps is "
+                "O(T^2) — expect a long job; pass refitEvery=0 "
+                "(auto) or k>1 for grouped refits", values.shape[1])
         calc, std, anom = arima_scores(values, mask,
                                        refit_every=refit)
     elif algo == "DBSCAN":
@@ -68,7 +94,8 @@ def run_tad(db: FlowDatabase, algo: str, spec: TadQuerySpec,
 
     if progress:
         progress.stage("score")
-    rows = detect_anomalies(batch, algo, tad_id, now=now)
+    rows = detect_anomalies(batch, algo, tad_id, now=now,
+                            refit_every=spec.refit_every)
 
     if progress:
         progress.stage("write")
@@ -79,15 +106,23 @@ def run_tad(db: FlowDatabase, algo: str, spec: TadQuerySpec,
 
 
 def detect_anomalies(batch: SeriesBatch, algo: str, tad_id: str,
-                     now: Optional[int] = None):
+                     now: Optional[int] = None, refit_every: int = 1):
     """Score a series batch and materialize tadetector result rows."""
+    refit = effective_refit(
+        algo, refit_every,
+        batch.values.shape[1] if batch.n_series else 0)
     if batch.n_series == 0:
-        return [_no_anomaly_row(batch.agg_type, algo, tad_id, now)]
+        return [_no_anomaly_row(batch.agg_type, algo, tad_id, now,
+                                refit)]
 
-    calc, std, anom = score_series(batch.values, batch.mask, algo)
+    # Pass the resolved cadence so the emitted refitEvery and the one
+    # actually executed cannot drift (effective_refit is idempotent).
+    calc, std, anom = score_series(batch.values, batch.mask, algo,
+                                   refit_every=refit if refit else 1)
     sidx, tidx = np.nonzero(anom)
     if sidx.size == 0:
-        return [_no_anomaly_row(batch.agg_type, algo, tad_id, now)]
+        return [_no_anomaly_row(batch.agg_type, algo, tad_id, now,
+                                refit)]
 
     # stddev_samp is NULL (NaN) for 1-point series; those can't be
     # anomalous, but guard the cast anyway.
@@ -102,6 +137,7 @@ def detect_anomalies(batch: SeriesBatch, algo: str, tad_id: str,
             "algoCalc": float(calc[s, t]),
             "throughput": float(batch.values[s, t]),
             "anomaly": "true",
+            "refitEvery": refit,
             "id": tad_id,
         }
         # Series key names coincide with tadetector column names; keys
@@ -116,7 +152,8 @@ def detect_anomalies(batch: SeriesBatch, algo: str, tad_id: str,
 
 
 def _no_anomaly_row(agg_type: str, algo: str, tad_id: str,
-                    now: Optional[int]) -> Dict[str, object]:
+                    now: Optional[int],
+                    refit: int = 0) -> Dict[str, object]:
     """The reference's filler row (:401-419): string identity columns get
     'None', flowStartSeconds gets the wall clock, anomaly gets the
     sentinel text."""
@@ -139,5 +176,6 @@ def _no_anomaly_row(agg_type: str, algo: str, tad_id: str,
         "algoCalc": 0.0,
         "throughput": 0.0,
         "anomaly": "NO ANOMALY DETECTED",
+        "refitEvery": refit,
         "id": tad_id,
     }

@@ -221,6 +221,10 @@ def tad_run(args) -> None:
         "externalIp": args.external_ip or None,
         "servicePortName": args.svc_port_name or None,
         "clusterUUID": args.cluster_uuid or None,
+        # refitEvery=1 is the server default; 0 (auto) must survive the
+        # None-filter below, so only drop the default.
+        "refitEvery": args.refit_every
+        if args.refit_every != 1 else None,
         **_sizing_body(args),
     }
     body = {k: v for k, v in body.items() if v is not None}
@@ -522,6 +526,11 @@ def build_parser() -> argparse.ArgumentParser:
                          default="")
         run.add_argument("--cluster-uuid", dest="cluster_uuid",
                          default="")
+        run.add_argument("--refit-every", dest="refit_every", type=int,
+                         default=1,
+                         help="ARIMA refit cadence: 1 = exact "
+                              "refit-per-step (default), k>1 = grouped "
+                              "refits, 0 = auto for long series")
         sizing_flags(run)
 
     add_job_commands(tad, tad_run, tad_status, tad_retrieve, tad_list,

@@ -241,7 +241,10 @@ class JobController:
                         svc_port_name=str(
                             spec.get("servicePortName", "") or ""),
                         cluster_uuid=str(
-                            spec.get("clusterUUID", "") or "")),
+                            spec.get("clusterUUID", "") or ""),
+                        # 0 = auto cadence; absent = reference-exact.
+                        refit_every=int(spec["refitEvery"])
+                        if spec.get("refitEvery") is not None else 1),
                     tad_id=record.job_id,
                     progress=record.progress)
             elif record.kind == KIND_DD:

@@ -82,6 +82,10 @@ def build_parser() -> argparse.ArgumentParser:
     tad.add_argument("-c", "--cluster-uuid", dest="cluster_uuid",
                      default="",
                      help="scope to one cluster in a multicluster store")
+    tad.add_argument("--refit-every", "--refit_every",
+                     dest="refit_every", type=int, default=1,
+                     help="ARIMA refit cadence (1=exact per-step, "
+                          "0=auto for long series)")
     tad.add_argument("--progress-file", default=None)
 
     npr = sub.add_parser("npr", help="network policy recommendation")
@@ -130,6 +134,7 @@ def run_tad_job(args) -> str:
         external_ip=args.external_ip,
         svc_port_name=args.svc_port_name,
         cluster_uuid=args.cluster_uuid,
+        refit_every=args.refit_every,
     )
     if args.pod_namespace and not (args.pod_label or args.pod_name):
         raise SystemExit(

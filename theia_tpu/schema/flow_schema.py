@@ -167,6 +167,13 @@ TADETECTOR_SCHEMA: tuple = _cols(
     ("algoCalc", K.F64),
     ("throughput", K.F64),
     ("anomaly", K.STRING),
+    # Effective ARIMA refit cadence the job ran with (1 = the
+    # reference's exact refit-per-step, k>1 = grouped-refit
+    # approximation). 0 = no cadence recorded: non-ARIMA rows, or
+    # ARIMA rows migrated from pre-v5 stores (disambiguate via
+    # algoType). Extension beyond the reference schema so the
+    # approximation is observable in results.
+    ("refitEvery", K.U64),
     ("id", K.STRING),
 )
 

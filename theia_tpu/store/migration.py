@@ -13,7 +13,8 @@ Schema history (mirrors the reference's column evolution):
   v1 — flows without `trusted`           (pre policy-feedback)
   v2 — + `trusted` UInt8                 (subsequent-NPR support)
   v3 — + `egressName`, `egressIP`        (egress observability)
-  v4 — + `dropdetection` result table    (traffic-drop detection; current)
+  v4 — + `dropdetection` result table    (traffic-drop detection)
+  v5 — + `tadetector.refitEvery`         (ARIMA refit-cadence audit; current)
 """
 
 from __future__ import annotations
@@ -23,7 +24,7 @@ from typing import Callable, Dict, List
 
 import numpy as np
 
-CURRENT_SCHEMA_VERSION = 4
+CURRENT_SCHEMA_VERSION = 5
 VERSION_KEY = "__schema_version__"
 
 # framework version → schema version (reference VERSION_MAP,
@@ -33,14 +34,15 @@ VERSION_MAP = {
     "0.1.1": 2,
     "0.2.0": 3,
     "0.3.0": 4,
+    "0.4.0": 5,
 }
 
 Payload = Dict[str, np.ndarray]
 
 
-def _n_rows(payload: Payload) -> int:
+def _n_rows(payload: Payload, table: str = "flows") -> int:
     for key, arr in payload.items():
-        if key.startswith("flows/") and "__dict__" not in key:
+        if key.startswith(f"{table}/") and "__dict__" not in key:
             return len(arr)
     return 0
 
@@ -83,7 +85,26 @@ MIGRATIONS: List[Migration] = [
         version=4, name="add_dropdetection_table",
         up=lambda p: _add_dropdetection(p),
         down=lambda p: _drop_table(p, "dropdetection")),
+    Migration(
+        version=5, name="add_tadetector_refit_every",
+        # Pre-v5 rows predate the grouped-refit knob: every ARIMA job
+        # ran the then-hardwired auto cadence. The zero-fill means "no
+        # cadence recorded" (rows with algoType=ARIMA and refitEvery=0
+        # are legacy approximate results, not exact ones).
+        up=lambda p: _add_table_numeric(p, "tadetector", "refitEvery",
+                                        np.int64),
+        down=lambda p: _drop_key(p, "tadetector/refitEvery")),
 ]
+
+
+def _drop_key(payload: Payload, key: str) -> None:
+    payload.pop(key, None)
+
+
+def _add_table_numeric(payload: Payload, table: str, name: str,
+                       dtype) -> None:
+    payload[f"{table}/{name}"] = np.zeros(_n_rows(payload, table),
+                                          dtype)
 
 
 def _add_dropdetection(payload: Payload) -> None:
