@@ -72,7 +72,9 @@ def read_distinct_flows(flows: ColumnarBatch,
                         start_time: Optional[int] = None,
                         end_time: Optional[int] = None,
                         unprotected: bool = True,
-                        rm_labels: bool = True) -> List[Dict[str, object]]:
+                        rm_labels: bool = True,
+                        mesh=None,
+                        use_device=None) -> List[Dict[str, object]]:
     """SELECT DISTINCT 9 columns with the job's WHERE clause
     (generate_sql_query :785-802). The distinct runs vectorized over
     dictionary codes; decode happens only for the surviving rows."""
@@ -92,7 +94,8 @@ def read_distinct_flows(flows: ColumnarBatch,
     # distinct kernel it feeds).
     col = flows.column_selector(mask)
     keys = np.stack([col(c) for c in FLOW_TABLE_COLUMNS], axis=1)
-    uniq, _counts = device_distinct(keys)
+    uniq, _counts = device_distinct(keys, use_device=use_device,
+                                    mesh=mesh)
 
     rows: List[Dict[str, object]] = []
     for r in uniq:
@@ -302,11 +305,32 @@ def run_npr(db: FlowDatabase,
             to_services: bool = True,
             recommendation_id: Optional[str] = None,
             now: Optional[datetime.datetime] = None,
-            progress=None) -> str:
-    """Run a full NPR job against the database; returns the job id."""
+            progress=None, mesh="auto") -> str:
+    """Run a full NPR job against the database; returns the job id.
+
+    `mesh`: "auto" shards the DISTINCT kernel over every visible device
+    (parallel.job_mesh; single-device hosts keep the plain path), None
+    forces single-device, or pass an explicit mesh. Any mesh is
+    flattened onto a rows axis for the distinct shuffle.
+    """
     if recommendation_type not in ("initial", "subsequent"):
         raise ValueError(
             f"type must be initial|subsequent, got {recommendation_type}")
+    use_device = None
+    if isinstance(mesh, str):
+        if mesh != "auto":
+            raise ValueError(
+                f"mesh must be 'auto', None or a Mesh, got {mesh!r} "
+                f"(use THEIA_MESH=off to disable sharding)")
+        from ..parallel import job_mesh
+        mesh = job_mesh()
+    elif mesh is not None:
+        # An explicitly passed mesh is an opt-in to the device
+        # distinct — don't gate it behind the auto size threshold.
+        use_device = True
+    if mesh is not None:
+        from ..parallel import make_rows_mesh
+        mesh = make_rows_mesh(devices=mesh.devices.flatten())
     ns_allow_list = list(ns_allow_list if ns_allow_list is not None
                          else NAMESPACE_ALLOW_LIST)
     recommendation_id = recommendation_id or str(uuid.uuid4())
@@ -316,7 +340,7 @@ def run_npr(db: FlowDatabase,
     flows = db.flows.scan()
     unprotected = read_distinct_flows(
         flows, limit, start_time, end_time, unprotected=True,
-        rm_labels=rm_labels)
+        rm_labels=rm_labels, mesh=mesh, use_device=use_device)
 
     if progress:
         progress.stage("recommend")
@@ -331,7 +355,7 @@ def run_npr(db: FlowDatabase,
         if option in (1, 2):
             trusted = read_distinct_flows(
                 flows, limit, start_time, end_time, unprotected=False,
-                rm_labels=rm_labels)
+                rm_labels=rm_labels, mesh=mesh, use_device=use_device)
             result = merge_policy_dict(
                 result,
                 recommend_antrea_policies(

@@ -136,13 +136,18 @@ def make_sharded_distinct(mesh: jax.sharding.Mesh):
 
 
 def device_distinct(keys: np.ndarray,
-                    use_device: str | bool | None = None
+                    use_device: str | bool | None = None,
+                    mesh: jax.sharding.Mesh | None = None
                     ) -> Tuple[np.ndarray, np.ndarray]:
     """Host wrapper: DISTINCT + counts for an [N, K] int code matrix.
 
     Returns (uniq [U, K] int64, counts [U] int64) in lexicographic row
     order — bit-identical to the numpy group_reduce path. `use_device`
     defaults to the THEIA_NPR_DEVICE env switch ("auto"/"1"/"0").
+    With `mesh` (a rows-axis mesh with >1 device), the device path
+    shards input rows over the mesh and merges per-chip distincts with
+    the all_gather + segment-sum collective (production scale-out of
+    the Spark shuffle, SURVEY §2.7).
     """
     n = keys.shape[0]
     if n == 0:
@@ -166,7 +171,20 @@ def device_distinct(keys: np.ndarray,
 
     if keys.max(initial=0) >= _SENTINEL:
         raise ValueError("dictionary code collides with the sentinel")
-    uniq, counts, n_unique = distinct_rows(keys.astype(np.int32))
+    if mesh is not None and mesh.size > 1 and n >= mesh.size:
+        from ..parallel import cached_kernel
+        from ..parallel.mesh import pad_to_multiple
+
+        # Pad rows to the shard multiple with the sentinel; padding
+        # rows sort to the end of the merge and the step drops the
+        # trailing all-sentinel segment.
+        padded, _ = pad_to_multiple(keys.astype(np.int32), mesh.size,
+                                    axis=0, fill=_SENTINEL)
+        fn = cached_kernel(("npr_distinct", mesh),
+                           lambda: make_sharded_distinct(mesh))
+        uniq, counts, n_unique = fn(padded)
+    else:
+        uniq, counts, n_unique = distinct_rows(keys.astype(np.int32))
     u = int(n_unique)
     return (np.asarray(uniq[:u]).astype(np.int64),
             np.asarray(counts[:u]).astype(np.int64))

@@ -44,13 +44,70 @@ def effective_refit(algo: str, refit_every: int, n_steps: int) -> int:
     return refit_every if refit_every else max(1, n_steps // 2048)
 
 
+def _score_series_sharded(values, mask, algo, refit_every, mesh):
+    """Score over a device mesh: data-parallel over series (plus
+    sequence-parallel over time for EWMA). The sharded kernels run the
+    same per-series computation as the single-device path, so result
+    rows are identical — this is the reference's `executorInstances`
+    scale-out applied to the production job (SURVEY §2.7 row 1)."""
+    from ..parallel import (cached_kernel, make_sharded_arima,
+                            make_sharded_dbscan, make_sharded_ewma,
+                            pad_to_multiple, shard_arrays)
+    from ..parallel.mesh import SERIES_AXIS, TIME_AXIS
+    from ..parallel.tad_sharded import make_series_sharded
+
+    S, T = values.shape
+    values, _ = pad_to_multiple(values, mesh.shape[SERIES_AXIS], axis=0)
+    mask, _ = pad_to_multiple(mask, mesh.shape[SERIES_AXIS], axis=0)
+    if algo == "EWMA" and mesh.shape.get(TIME_AXIS, 1) > 1:
+        # Sequence-parallel scan over the mesh's time axis (its stddev
+        # psum may differ from the local kernel in the last float bit;
+        # the job path uses time_shards=1 meshes, which are exact).
+        values, _ = pad_to_multiple(values, mesh.shape[TIME_AXIS],
+                                    axis=1)
+        mask, _ = pad_to_multiple(mask, mesh.shape[TIME_AXIS], axis=1)
+        fn = cached_kernel(("ewma_time", mesh),
+                           lambda: make_sharded_ewma(mesh))
+        calc, std, anom, _count = fn(*shard_arrays(mesh, values, mask))
+    elif algo == "EWMA":
+        fn = cached_kernel(
+            ("ewma", mesh),
+            lambda: make_series_sharded(mesh, ewma_scores))
+        calc, std, anom = fn(*shard_arrays(mesh, values, mask))
+    elif algo == "ARIMA":
+        refit = effective_refit(algo, refit_every, T)
+        fn = cached_kernel(
+            ("arima", mesh, refit),
+            lambda: make_sharded_arima(mesh, refit_every=refit))
+        calc, std, anom = fn(*shard_arrays(mesh, values, mask))
+    else:
+        from ..ops.dbscan import DEFAULT_EPS, DEFAULT_MIN_SAMPLES
+        fn = cached_kernel(
+            ("dbscan", mesh),
+            lambda: make_sharded_dbscan(
+                mesh, eps=DEFAULT_EPS,
+                min_samples=DEFAULT_MIN_SAMPLES))
+        calc, std, anom = fn(*shard_arrays(mesh, values, mask))
+    return (np.asarray(calc)[:S, :T], np.asarray(std)[:S],
+            np.asarray(anom)[:S, :T])
+
+
 def score_series(values: np.ndarray, mask: np.ndarray, algo: str,
-                 refit_every: int = 1):
+                 refit_every: int = 1, mesh=None):
     """Run one algorithm over a padded [S, T] batch.
 
     Returns (algo_calc [S,T], stddev [S], anomaly [S,T]) as numpy.
     `refit_every` applies to ARIMA only (see `effective_refit`).
+    With `mesh` (a jax.sharding.Mesh with >1 device), scoring shards
+    over the mesh and results stay identical to the local path.
     """
+    if algo not in ALGORITHMS:
+        raise ValueError(
+            f"algo must be one of {ALGORITHMS}, got {algo!r}")
+    if mesh is not None and mesh.size > 1 and \
+            values.shape[0] >= mesh.size:
+        return _score_series_sharded(values, mask, algo, refit_every,
+                                     mesh)
     if algo == "EWMA":
         calc, std, anom = ewma_scores(values, mask)
     elif algo == "ARIMA":
@@ -67,21 +124,30 @@ def score_series(values: np.ndarray, mask: np.ndarray, algo: str,
                 "(auto) or k>1 for grouped refits", values.shape[1])
         calc, std, anom = arima_scores(values, mask,
                                        refit_every=refit)
-    elif algo == "DBSCAN":
-        calc, std, anom = dbscan_scores(values, mask)
     else:
-        raise ValueError(
-            f"algo must be one of {ALGORITHMS}, got {algo!r}")
+        calc, std, anom = dbscan_scores(values, mask)
     return np.asarray(calc), np.asarray(std), np.asarray(anom)
 
 
 def run_tad(db: FlowDatabase, algo: str, spec: TadQuerySpec,
             tad_id: Optional[str] = None,
             now: Optional[int] = None,
-            progress=None) -> str:
-    """Execute a full TAD job against the database; returns the job id."""
+            progress=None, mesh="auto") -> str:
+    """Execute a full TAD job against the database; returns the job id.
+
+    `mesh`: "auto" scores over every visible device (parallel.job_mesh;
+    single-device hosts and THEIA_MESH=off keep the plain path), None
+    forces single-device, or pass an explicit jax.sharding.Mesh.
+    """
     if algo not in ALGORITHMS:
         raise ValueError(f"algo must be one of {ALGORITHMS}, got {algo!r}")
+    if isinstance(mesh, str):
+        if mesh != "auto":
+            raise ValueError(
+                f"mesh must be 'auto', None or a Mesh, got {mesh!r} "
+                f"(use THEIA_MESH=off to disable sharding)")
+        from ..parallel import job_mesh
+        mesh = job_mesh()
     tad_id = tad_id or str(uuid.uuid4())
 
     if progress:
@@ -95,7 +161,7 @@ def run_tad(db: FlowDatabase, algo: str, spec: TadQuerySpec,
     if progress:
         progress.stage("score")
     rows = detect_anomalies(batch, algo, tad_id, now=now,
-                            refit_every=spec.refit_every)
+                            refit_every=spec.refit_every, mesh=mesh)
 
     if progress:
         progress.stage("write")
@@ -106,7 +172,8 @@ def run_tad(db: FlowDatabase, algo: str, spec: TadQuerySpec,
 
 
 def detect_anomalies(batch: SeriesBatch, algo: str, tad_id: str,
-                     now: Optional[int] = None, refit_every: int = 1):
+                     now: Optional[int] = None, refit_every: int = 1,
+                     mesh=None):
     """Score a series batch and materialize tadetector result rows."""
     refit = effective_refit(
         algo, refit_every,
@@ -118,7 +185,8 @@ def detect_anomalies(batch: SeriesBatch, algo: str, tad_id: str,
     # Pass the resolved cadence so the emitted refitEvery and the one
     # actually executed cannot drift (effective_refit is idempotent).
     calc, std, anom = score_series(batch.values, batch.mask, algo,
-                                   refit_every=refit if refit else 1)
+                                   refit_every=refit if refit else 1,
+                                   mesh=mesh)
     sidx, tidx = np.nonzero(anom)
     if sidx.size == 0:
         return [_no_anomaly_row(batch.agg_type, algo, tad_id, now,

@@ -1,0 +1,71 @@
+"""Job-level mesh selection: route production jobs onto every visible
+chip.
+
+The reference scales its production jobs by raising
+`executorInstances` on the SparkApplication spec
+(pkg/controller/networkpolicyrecommendation/controller.go:573-675);
+nothing in the job itself changes. The TPU-native equivalent is this
+module: `job_mesh()` inspects the visible devices once and hands the
+analytics jobs a `jax.sharding.Mesh` to score over — `run_tad` /
+`run_npr` call it by default, so the same manager-API job that runs
+single-device on one chip runs sharded on a slice with no spec change.
+
+Env switches:
+  THEIA_MESH=off    — force single-device even on a multi-chip host
+  THEIA_MESH=auto   — (default) all visible devices when >1
+  THEIA_MESH=<N>    — first N visible devices
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+
+from .mesh import Mesh, make_mesh
+
+_lock = threading.Lock()
+_cache: Dict[str, Optional[Mesh]] = {}
+# Jitted shard_map builders are cached per (mesh, kernel, params): the
+# builders close over the mesh and re-running them would re-trace.
+_fn_cache: Dict[Tuple, Callable] = {}
+
+
+def job_mesh() -> Optional[Mesh]:
+    """The mesh production jobs should score over, or None for the
+    plain single-device path. Resolved once per THEIA_MESH value."""
+    setting = os.environ.get("THEIA_MESH", "auto").strip().lower()
+    with _lock:
+        if setting in _cache:
+            return _cache[setting]
+    if setting in ("off", "0", "none", "false"):
+        mesh = None
+    else:
+        n = len(jax.devices())
+        if setting not in ("auto", ""):
+            n = min(n, max(1, int(setting)))
+        mesh = make_mesh(n) if n > 1 else None
+    with _lock:
+        _cache[setting] = mesh
+    return mesh
+
+
+def cached_kernel(key: Tuple, build: Callable[[], Callable]) -> Callable:
+    """Memoize a jitted shard_map kernel under a hashable key."""
+    with _lock:
+        fn = _fn_cache.get(key)
+    if fn is None:
+        fn = build()
+        with _lock:
+            _fn_cache[key] = fn
+    return fn
+
+
+def reset_cache() -> None:
+    """Test hook: drop memoized meshes/kernels (e.g. after changing
+    THEIA_MESH or the visible device set)."""
+    with _lock:
+        _cache.clear()
+        _fn_cache.clear()

@@ -108,3 +108,93 @@ def shard_arrays(mesh: Mesh, x, mask) -> Tuple[jax.Array, jax.Array]:
     """device_put host arrays with the step's input sharding."""
     spec = NamedSharding(mesh, P(SERIES_AXIS, TIME_AXIS))
     return jax.device_put(x, spec), jax.device_put(mask, spec)
+
+
+def make_series_sharded(mesh: Mesh, kernel):
+    """Data parallelism over the series axis for any scoring kernel
+    with the (x [S,T], mask [S,T]) → (calc, std [S], anomaly) shape.
+
+    Per-series work is independent (SURVEY §2.7 row 2: Spark's
+    per-series task parallelism → series sharding), so the sharded
+    step is the single-device kernel applied to each chip's series
+    slab — no collectives, and per-series outputs are BIT-IDENTICAL
+    to the single-device kernel (same computation graph per series).
+    The time axis of the mesh (if >1) replicates.
+    """
+    mapped = jax.shard_map(
+        kernel, mesh=mesh,
+        in_specs=(P(SERIES_AXIS, None), P(SERIES_AXIS, None)),
+        out_specs=(P(SERIES_AXIS, None), P(SERIES_AXIS),
+                   P(SERIES_AXIS, None)),
+        check_vma=False)
+    return jax.jit(mapped)
+
+
+def make_sharded_arima(mesh: Mesh, refit_every: int = 1):
+    """Sharded ARIMA scoring (series data parallelism; every
+    (series, prefix) fit is independent — the walk-forward scan stays
+    local to each shard)."""
+    from ..ops.arima import arima_scores
+
+    def step(x, mask):
+        return arima_scores(x, mask, refit_every=refit_every)
+
+    return make_series_sharded(mesh, step)
+
+
+def make_sharded_dbscan(mesh: Mesh, eps: float, min_samples: int):
+    """Sharded per-series DBSCAN noise scoring over the series axis.
+
+    Each series' [T, T] distance test is independent, so series shards
+    run the single-device formulation locally (the Pallas kernel on
+    real TPU shards, the fused XLA formulation elsewhere — same
+    auto-selection as `ops.dbscan.dbscan_scores`).
+    """
+    from ..ops.dbscan import dbscan_scores
+
+    def step(x, mask):
+        return dbscan_scores(x, mask, eps=eps, min_samples=min_samples)
+
+    return make_series_sharded(mesh, step)
+
+
+def make_sharded_points_dbscan(mesh: Mesh, eps: float,
+                               min_samples: int = 4):
+    """Sharded spatial DBSCAN over [N, F] point embeddings.
+
+    The tiled two-pass of `ops.dbscan.dbscan_points_noise` shards over
+    tile rows (mesh axis `rows`): each chip evaluates its row block
+    against the full point set (one all_gather of the points), derives
+    complete neighbor counts → local core flags, then a second
+    all_gather shares the core flags for the reachability pass — the
+    collective structure SURVEY §2.7 maps DBSCAN's region query onto.
+
+    Returns fn(points [N, F] f32, valid [N] bool) → noise [N] bool,
+    N divisible by the rows-axis size.
+    """
+    from .mesh import ROWS_AXIS
+
+    eps2 = eps * eps
+
+    def step(pts_loc, valid_loc):
+        pts_all = jax.lax.all_gather(pts_loc, ROWS_AXIS)
+        pts_all = pts_all.reshape(-1, pts_loc.shape[1])
+        valid_all = jax.lax.all_gather(valid_loc, ROWS_AXIS).reshape(-1)
+        t2 = (pts_loc * pts_loc).sum(-1)
+        x2 = (pts_all * pts_all).sum(-1)
+        d2 = t2[:, None] + x2[None, :] - 2.0 * jnp.matmul(
+            pts_loc, pts_all.T, precision=jax.lax.Precision.HIGHEST)
+        within = (d2 <= eps2) & valid_all[None, :] & valid_loc[:, None]
+        counts = within.sum(-1)
+        core_loc = (counts >= min_samples) & valid_loc
+        core_all = jax.lax.all_gather(core_loc, ROWS_AXIS).reshape(-1)
+        reach = (within & core_all[None, :]).any(-1)
+        return valid_loc & ~core_loc & ~reach
+
+    from jax.sharding import PartitionSpec as P2
+    mapped = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P2(ROWS_AXIS, None), P2(ROWS_AXIS)),
+        out_specs=P2(ROWS_AXIS),
+        check_vma=False)
+    return jax.jit(mapped)
