@@ -58,18 +58,26 @@ void* gs_build(const void** key_cols, const int32_t* key_widths,
   gs->m = m;
   if (n == 0) return gs;
 
-  // Stage keys row-major once (C loop beats k numpy astype+stack).
-  std::vector<int64_t> rows(static_cast<size_t>(n) * k);
+  // Pass 1: per-row key hash, computed COLUMNWISE — sequential reads
+  // of each key column and sequential writes of hash[n]. (The previous
+  // version staged keys row-major first: for k≈20 the c-strided writes
+  // touched a fresh cache line per cell, and that staging dominated
+  // the whole group-by.) Column order is applied identically for every
+  // row, so the hash equals the row-major FNV of the same cells.
+  std::vector<uint64_t> hash(n, 1469598103934665603ull);
   for (int32_t c = 0; c < k; ++c) {
-    const void* col = key_cols[c];
     const int32_t w = key_widths[c];
-    int64_t* out = rows.data() + c;
+    uint64_t* hp = hash.data();
     if (w == 8) {
-      const int64_t* src = static_cast<const int64_t*>(col);
-      for (int64_t r = 0; r < n; ++r) out[r * k] = src[r];
+      const int64_t* src = static_cast<const int64_t*>(key_cols[c]);
+      for (int64_t r = 0; r < n; ++r)
+        hp[r] = (hp[r] ^ mix(static_cast<uint64_t>(src[r])))
+                * 1099511628211ull;
     } else {
-      const int32_t* src = static_cast<const int32_t*>(col);
-      for (int64_t r = 0; r < n; ++r) out[r * k] = src[r];
+      const int32_t* src = static_cast<const int32_t*>(key_cols[c]);
+      for (int64_t r = 0; r < n; ++r)
+        hp[r] = (hp[r] ^ mix(static_cast<uint64_t>(src[r])))
+                * 1099511628211ull;
     }
   }
 
@@ -77,37 +85,68 @@ void* gs_build(const void** key_cols, const int32_t* key_widths,
   while (cap < static_cast<size_t>(n) * 2) cap <<= 1;
   std::vector<int64_t> slot_row(cap, -1);   // representative row
   std::vector<int64_t> slot_gid(cap, -1);
+  std::vector<uint64_t> slot_hash(cap, 0);
 
-  gs->keys.reserve(static_cast<size_t>(n) * k / 4);
-  gs->sums.reserve(static_cast<size_t>(n) * m / 4);
+  // Pass 2: probe to a group id per row. Equality first checks the
+  // full 64-bit hash, then compares cells straight from the original
+  // columns (k scattered reads only on genuine hash match — nearly
+  // always a real group hit).
+  std::vector<int32_t> gid(n);
+  // Worst case every row is its own group (true for the flows views,
+  // whose keys include per-row timestamps) — preallocate so the
+  // new-group path is a straight write, then shrink once at the end.
+  gs->keys.resize(static_cast<size_t>(n) * k);
   for (int64_t r = 0; r < n; ++r) {
-    const int64_t* row = rows.data() + r * k;
-    uint64_t h = 1469598103934665603ull;
-    for (int32_t i = 0; i < k; ++i) {
-      h ^= mix(static_cast<uint64_t>(row[i]));
-      h *= 1099511628211ull;
-    }
-    h &= cap - 1;
-    int64_t gid;
+    const uint64_t hv = hash[r];
+    size_t h = hv & (cap - 1);
     for (;;) {
       if (slot_row[h] < 0) {
-        gid = gs->g++;
         slot_row[h] = r;
-        slot_gid[h] = gid;
-        gs->keys.insert(gs->keys.end(), row, row + k);
-        gs->sums.insert(gs->sums.end(), m, 0);
+        slot_gid[h] = gs->g;
+        slot_hash[h] = hv;
+        int64_t* dst = gs->keys.data() +
+                       static_cast<size_t>(gs->g) * k;
+        for (int32_t i = 0; i < k; ++i)
+          dst[i] = read_cell(key_cols[i], key_widths[i], r);
+        gid[r] = static_cast<int32_t>(gs->g++);
         break;
       }
-      if (!memcmp(rows.data() + slot_row[h] * k, row,
-                  static_cast<size_t>(k) * sizeof(int64_t))) {
-        gid = slot_gid[h];
-        break;
+      if (slot_hash[h] == hv) {
+        const int64_t rep = slot_row[h];
+        bool eq = true;
+        for (int32_t i = 0; i < k; ++i) {
+          if (read_cell(key_cols[i], key_widths[i], r) !=
+              read_cell(key_cols[i], key_widths[i], rep)) {
+            eq = false;
+            break;
+          }
+        }
+        if (eq) {
+          gid[r] = static_cast<int32_t>(slot_gid[h]);
+          break;
+        }
       }
       h = (h + 1) & (cap - 1);
     }
-    int64_t* acc = gs->sums.data() + gid * m;
-    for (int32_t j = 0; j < m; ++j)
-      acc[j] += read_cell(val_cols[j], val_widths[j], r);
+  }
+
+  gs->keys.resize(static_cast<size_t>(gs->g) * k);
+
+  // Pass 3: accumulate sums COLUMNWISE — each value column is read
+  // sequentially; the accumulator rows are few and stay cache-hot.
+  gs->sums.assign(static_cast<size_t>(gs->g) * m, 0);
+  for (int32_t j = 0; j < m; ++j) {
+    const int32_t w = val_widths[j];
+    int64_t* sums = gs->sums.data() + j;
+    if (w == 8) {
+      const int64_t* src = static_cast<const int64_t*>(val_cols[j]);
+      for (int64_t r = 0; r < n; ++r)
+        sums[static_cast<size_t>(gid[r]) * m] += src[r];
+    } else {
+      const int32_t* src = static_cast<const int32_t*>(val_cols[j]);
+      for (int64_t r = 0; r < n; ++r)
+        sums[static_cast<size_t>(gid[r]) * m] += src[r];
+    }
   }
   return gs;
 }

@@ -77,10 +77,12 @@ class HeavyHitterDetector:
         src = np.asarray(batch["sourceIP"], np.int64)
         # peer fan-in: DISTINCT sources per destination in this batch —
         # a 64-source flood and one chatty source sending 64 flows must
-        # score differently.
-        pairs = np.unique(np.stack([dst, src], axis=1), axis=0)
-        per_dst_dsts, per_dst_counts = np.unique(pairs[:, 0],
-                                                 return_counts=True)
+        # score differently. One 1-D unique over a packed 64-bit
+        # (dst, src) key instead of np.unique(axis=0)'s row-structured
+        # sort (codes are int32, so the pack is lossless).
+        pairs = np.unique((dst << np.int64(32)) | src)
+        per_dst_dsts, per_dst_counts = np.unique(
+            pairs >> np.int64(32), return_counts=True)
         fan_in = per_dst_counts[
             np.searchsorted(per_dst_dsts, dst)].astype(np.float64)
         mean_pkt = octets / np.maximum(packets, 1.0)

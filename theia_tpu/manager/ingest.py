@@ -98,8 +98,11 @@ class IngestManager:
         # Detector keys must be stable across streams and stream
         # resets; stream-local dictionary codes are neither, so
         # destinations re-encode against this ingest-global dictionary
-        # before scoring.
+        # before scoring. The re-encode is an int32 code remap through
+        # a cached per-source-dictionary mapping (extended only for
+        # newly minted entries) — no string objects on the hot path.
         self._dst_dict = StringDictionary()
+        self._dst_maps: Dict[int, tuple] = {}   # id(src) → (src, map)
 
     def _stream(self, stream_id: str) -> _Stream:
         with self._registry_lock:
@@ -137,6 +140,14 @@ class IngestManager:
         malformed payloads (mapped to HTTP 400 by the API layer); the
         failing stream is reset and must restart its encoder."""
         st = self._stream(stream)
+        # The stream lock guards only the DECODE (the dictionary-delta
+        # chain is per-stream state); the store insert runs outside it,
+        # so one producer's slow insert (TTL scan, MV fan-out) never
+        # blocks its next block's decode on another thread, and
+        # different streams insert fully concurrently. Store-visible
+        # order across racing blocks of one stream is not defined — the
+        # store orders by timeInserted, not arrival, exactly like
+        # concurrent INSERTs on one ClickHouse connection pool.
         with st.lock:
             try:
                 if payload[:4] in (BLOCK_MAGIC, BLOCK_MAGIC_V1):
@@ -149,14 +160,13 @@ class IngestManager:
                 # discard the stream rather than serve a desynced one.
                 self._drop_stream(stream, st)
                 raise
-            n = self.db.insert_flows(batch)
+        n = self.db.insert_flows(batch)
         with self._detector_lock:
             # Re-encode destinations against the ingest-global
             # dictionary: CMS keys persist across batches, so they must
             # mean the same destination whichever stream (or stream
             # generation) produced the batch.
-            gcodes = self._dst_dict.encode(
-                list(batch.strings("destinationIP"))).astype(np.int32)
+            gcodes = self._global_dst_codes(batch)
             scored = ColumnarBatch(
                 {**batch.columns, "destinationIP": gcodes},
                 {**batch.dicts, "destinationIP": self._dst_dict})
@@ -171,6 +181,37 @@ class IngestManager:
             logger.v(1).info("ingested %d rows, %d alerts", n,
                              len(alerts))
         return {"rows": n, "alerts": len(alerts)}
+
+    def _global_dst_codes(self, batch: ColumnarBatch) -> np.ndarray:
+        """Map the batch's stream-local destinationIP codes onto the
+        ingest-global dictionary via a cached int32 mapping (amortized
+        O(new dictionary entries), not O(rows) string work). Caller
+        holds the detector lock. Keeps a strong reference to each
+        source dictionary so an id() can never be reused while its
+        mapping is cached (streams are bounded by MAX_STREAMS)."""
+        src = batch.dicts["destinationIP"]
+        entry = self._dst_maps.pop(id(src), None)
+        if entry is None or entry[0] is not src:
+            if len(self._dst_maps) >= 2 * MAX_STREAMS:
+                # Stream resets mint new dictionaries; drop the
+                # least-recently-used mappings so reset churn can't
+                # grow this unboundedly. Every lookup re-inserts its
+                # key (pop above + insert below), so insertion order
+                # IS recency order and the front of the dict holds the
+                # coldest entries — reset-orphaned dictionaries age to
+                # the front, active streams stay at the back.
+                for stale in list(self._dst_maps)[:MAX_STREAMS]:
+                    del self._dst_maps[stale]
+            entry = (src, np.zeros(0, np.int32))
+        src_ref, mapping = entry
+        if len(mapping) < len(src):
+            new = np.fromiter(
+                (self._dst_dict.encode_one(s)
+                 for s in src.entries_since(len(mapping))),
+                dtype=np.int32)
+            mapping = np.concatenate([mapping, new])
+        self._dst_maps[id(src)] = (src_ref, mapping)
+        return mapping[np.asarray(batch["destinationIP"], np.int64)]
 
     def recent_alerts(self, limit: int = 100) -> List[Dict[str, object]]:
         with self._alerts_lock:

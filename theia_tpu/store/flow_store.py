@@ -34,21 +34,14 @@ from ..schema import (
     ColumnarBatch,
     StringDictionary,
 )
+from ..utils.pool import get_pool
 from .views import MATERIALIZED_VIEWS, ViewTable
-
-_VIEW_POOL: Optional[concurrent.futures.ThreadPoolExecutor] = None
-_VIEW_POOL_LOCK = threading.Lock()
 
 
 def _view_pool() -> concurrent.futures.ThreadPoolExecutor:
     """Shared pool for parallel MV fan-out (native group-sum releases
     the GIL, so the three aggregations genuinely overlap)."""
-    global _VIEW_POOL
-    with _VIEW_POOL_LOCK:
-        if _VIEW_POOL is None:
-            _VIEW_POOL = concurrent.futures.ThreadPoolExecutor(
-                max_workers=4, thread_name_prefix="mv-fanout")
-        return _VIEW_POOL
+    return get_pool("mv-fanout", 4)
 
 
 class Table:

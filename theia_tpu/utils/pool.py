@@ -1,0 +1,28 @@
+"""Named lazy thread pools shared across the store tier.
+
+One registry instead of per-module singleton boilerplate: pools are
+created on first use and live for the process (daemon threads; the
+work items are short CPU-bound tasks whose native kernels release the
+GIL).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import threading
+from typing import Dict
+
+_pools: Dict[str, concurrent.futures.ThreadPoolExecutor] = {}
+_lock = threading.Lock()
+
+
+def get_pool(name: str,
+             max_workers: int) -> concurrent.futures.ThreadPoolExecutor:
+    """The process-wide pool registered under `name` (created with
+    `max_workers` on first call; later calls reuse it as-is)."""
+    with _lock:
+        pool = _pools.get(name)
+        if pool is None:
+            pool = _pools[name] = concurrent.futures.ThreadPoolExecutor(
+                max_workers=max_workers, thread_name_prefix=name)
+        return pool
