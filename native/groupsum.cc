@@ -91,7 +91,7 @@ void* gs_build(const void** key_cols, const int32_t* key_widths,
   // full 64-bit hash, then compares cells straight from the original
   // columns (k scattered reads only on genuine hash match — nearly
   // always a real group hit).
-  std::vector<int32_t> gid(n);
+  std::vector<int64_t> gid(n);
   // Worst case every row is its own group (true for the flows views,
   // whose keys include per-row timestamps) — preallocate so the
   // new-group path is a straight write, then shrink once at the end.
@@ -108,7 +108,7 @@ void* gs_build(const void** key_cols, const int32_t* key_widths,
                        static_cast<size_t>(gs->g) * k;
         for (int32_t i = 0; i < k; ++i)
           dst[i] = read_cell(key_cols[i], key_widths[i], r);
-        gid[r] = static_cast<int32_t>(gs->g++);
+        gid[r] = gs->g++;
         break;
       }
       if (slot_hash[h] == hv) {
@@ -122,7 +122,7 @@ void* gs_build(const void** key_cols, const int32_t* key_widths,
           }
         }
         if (eq) {
-          gid[r] = static_cast<int32_t>(slot_gid[h]);
+          gid[r] = slot_gid[h];
           break;
         }
       }

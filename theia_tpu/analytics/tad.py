@@ -99,7 +99,12 @@ def score_series(values: np.ndarray, mask: np.ndarray, algo: str,
     Returns (algo_calc [S,T], stddev [S], anomaly [S,T]) as numpy.
     `refit_every` applies to ARIMA only (see `effective_refit`).
     With `mesh` (a jax.sharding.Mesh with >1 device), scoring shards
-    over the mesh and results stay identical to the local path.
+    over the mesh; results are identical to the local path for
+    series-sharded meshes (time_shards=1 — the job_mesh() default). An
+    explicitly time-sharded mesh routes EWMA through the psum-reduced
+    stddev, which is only bit-approximate: anomaly flags at exact
+    threshold boundaries can differ (route through make_series_sharded
+    when exactness is required).
     """
     if algo not in ALGORITHMS:
         raise ValueError(

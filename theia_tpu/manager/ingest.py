@@ -147,7 +147,13 @@ class IngestManager:
         # different streams insert fully concurrently. Store-visible
         # order across racing blocks of one stream is not defined — the
         # store orders by timeInserted, not arrival, exactly like
-        # concurrent INSERTs on one ClickHouse connection pool.
+        # concurrent INSERTs on one ClickHouse connection pool. The
+        # same holds for the DETECTOR leg: streaming state (CMS counts,
+        # EWMA recurrences) is order-sensitive, so a producer that
+        # pipelines blocks of one stream concurrently gets
+        # nondeterministic alert output for the racing blocks; a
+        # producer that needs reproducible alerting must await each
+        # response before sending the next block.
         with st.lock:
             try:
                 if payload[:4] in (BLOCK_MAGIC, BLOCK_MAGIC_V1):

@@ -45,7 +45,12 @@ def job_mesh() -> Optional[Mesh]:
     else:
         n = len(jax.devices())
         if setting not in ("auto", ""):
-            n = min(n, max(1, int(setting)))
+            try:
+                n = min(n, max(1, int(setting)))
+            except ValueError:
+                raise ValueError(
+                    f"invalid THEIA_MESH={setting!r}: expected 'off', "
+                    f"'auto', or a device count N") from None
         mesh = make_mesh(n) if n > 1 else None
     with _lock:
         _cache[setting] = mesh

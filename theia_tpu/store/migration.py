@@ -91,8 +91,8 @@ MIGRATIONS: List[Migration] = [
         # ran the then-hardwired auto cadence. The zero-fill means "no
         # cadence recorded" (rows with algoType=ARIMA and refitEvery=0
         # are legacy approximate results, not exact ones).
-        up=lambda p: _add_table_numeric(p, "tadetector", "refitEvery",
-                                        np.int64),
+        up=lambda p: _add_table_schema_column(p, "tadetector",
+                                              "refitEvery"),
         down=lambda p: _drop_key(p, "tadetector/refitEvery")),
 ]
 
@@ -101,10 +101,17 @@ def _drop_key(payload: Payload, key: str) -> None:
     payload.pop(key, None)
 
 
-def _add_table_numeric(payload: Payload, table: str, name: str,
-                       dtype) -> None:
+def _add_table_schema_column(payload: Payload, table: str,
+                             name: str) -> None:
+    """Zero-fill a new numeric column with the LIVE schema's host dtype
+    so migrated payloads match freshly-saved ones (adopt-time casting in
+    flow_store would paper over a mismatch, but the on-disk format
+    shouldn't diverge)."""
+    from ..schema import TADETECTOR_SCHEMA
+    schema = {"tadetector": TADETECTOR_SCHEMA}[table]
+    col = next(c for c in schema if c.name == name)
     payload[f"{table}/{name}"] = np.zeros(_n_rows(payload, table),
-                                          dtype)
+                                          col.host_dtype)
 
 
 def _add_dropdetection(payload: Payload) -> None:
