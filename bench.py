@@ -21,6 +21,9 @@ rate, device transfer) go to stderr.
 from __future__ import annotations
 
 import json
+import os
+import signal
+import subprocess
 import sys
 import time
 
@@ -29,8 +32,91 @@ import numpy as np
 BASELINE_RECORDS_PER_SEC = 4000.0
 
 
+def _kill_strays() -> None:
+    """Kill leftover theia manager/runner processes before touching the
+    accelerator: a stray process still holding the chip is exactly what
+    produced round 3's 'TPU backend setup/compile error' — the bench
+    must own the device when the driver runs it."""
+    me = os.getpid()
+    try:
+        pids = [int(p) for p in os.listdir("/proc") if p.isdigit()]
+    except OSError:
+        return
+    for pid in pids:
+        if pid == me:
+            continue
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmd = f.read().replace(b"\0", b" ").decode(
+                    errors="replace")
+        except OSError:
+            continue
+        if "theia_tpu.manager" in cmd or "theia_tpu.runner" in cmd:
+            print(f"killing stray process {pid}: {cmd[:120]}",
+                  file=sys.stderr)
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass
+
+
+def _run_child(env: dict, timeout_s: float) -> bytes:
+    """Run the measurement in a child process (THEIA_BENCH_INNER=1) so
+    a hung accelerator tunnel can be killed instead of hanging the
+    whole bench. Returns the child's stdout (the JSON line) or b''."""
+    try:
+        child = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env={**env, "THEIA_BENCH_INNER": "1"},
+            stdout=subprocess.PIPE, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        print(f"bench child timed out after {timeout_s:.0f}s",
+              file=sys.stderr)
+        return b""
+    if child.returncode != 0:
+        print(f"bench child exited rc={child.returncode}",
+              file=sys.stderr)
+        return b""
+    return child.stdout.strip()
+
+
 def main() -> None:
+    """Always prints exactly one JSON result line on stdout, whatever
+    fails or HANGS. The orchestrator (this function) owns no JAX state;
+    it runs the measurement in a child on the default backend, and if
+    the child dies or stalls (round 3: jax.devices() hung on a dead
+    accelerator tunnel) retries once on the CPU backend, then emits a
+    value-0 line as the last resort."""
+    if os.environ.get("THEIA_BENCH_INNER") == "1":
+        print(json.dumps(run_benchmarks()))
+        return
+    _kill_strays()
+    timeout_s = float(os.environ.get("THEIA_BENCH_TIMEOUT", "420"))
+    out = _run_child(dict(os.environ), timeout_s)
+    if not out:
+        print("retrying on the CPU backend (degraded)", file=sys.stderr)
+        out = _run_child(
+            {**os.environ, "JAX_PLATFORMS": "cpu",
+             "THEIA_BENCH_FAST": "1"}, timeout_s)
+    if not out:
+        out = json.dumps({
+            "metric": "tad_ewma_scoring_records_per_sec", "value": 0,
+            "unit": "records/s", "vs_baseline": 0.0,
+            "error": "all backends failed or timed out; see stderr",
+        }).encode()
+    sys.stdout.buffer.write(out + b"\n")
+    sys.stdout.flush()
+
+
+def run_benchmarks() -> dict:
     import jax
+
+    # The axon sitecustomize hook sets jax_platforms programmatically,
+    # which overrides the env var — force the requested backend back
+    # (same dance as tests/conftest.py) or the CPU-fallback child would
+    # re-initialize the very accelerator tunnel it is falling back from.
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        jax.config.update("jax_platforms", "cpu")
 
     from theia_tpu.analytics import TadQuerySpec, build_series
     from theia_tpu.data.synth import SynthConfig, generate_flows
@@ -89,7 +175,12 @@ def main() -> None:
 
     # Secondary: ARIMA / DBSCAN steady-state device rates on a smaller
     # batch (ARIMA's walk-forward scan is far heavier than EWMA).
+    # THEIA_BENCH_FAST (set on the CPU-fallback retry) skips them —
+    # minutes of walk-forward ARIMA on a host core would starve the
+    # stages that still say something useful about the pipeline.
     try:
+        if os.environ.get("THEIA_BENCH_FAST") == "1":
+            raise RuntimeError("THEIA_BENCH_FAST=1")
         from theia_tpu.ops import arima_scores, dbscan_scores
         xs, ms = xd[:4096], md[:4096]
         for name, fn in (("ARIMA", arima_scores),
@@ -156,9 +247,10 @@ def main() -> None:
     # a dev-environment artifact ~2 orders of magnitude below a real
     # v5e host's DMA link — and letting the streaming state ride it
     # would time the tunnel, not the pipeline.
+    e2e_rate = 0.0
+    e2e_stages: dict = {}
     try:
         import contextlib
-        import os
 
         from theia_tpu.ingest import BlockEncoder, TsvDecoder, \
             native_available
@@ -195,13 +287,20 @@ def main() -> None:
                 db2.insert_flows(b)
             t_store = time.perf_counter() - ta
             t_det = max(dt - t_dec - t_store, 1e-9)
+            e2e_rate = n_e2e / dt
+            e2e_stages = {
+                "decode_rows_per_sec": round(n_e2e / t_dec),
+                "store_rows_per_sec": round(n_e2e / t_store),
+                "detector_rows_per_sec": round(n_e2e / t_det),
+            }
+            cap = min(e2e_stages, key=e2e_stages.get)
             print(f"end-to-end ingest (wire->store+views->detector"
-                  f"->alerts): {n_e2e / dt:,.0f} rows/s "
+                  f"->alerts): {e2e_rate:,.0f} rows/s "
                   f"[decode {n_e2e / t_dec:,.0f}, store "
                   f"{n_e2e / t_store:,.0f}, "
                   f"detector+rest {n_e2e / t_det:,.0f} rows/s; "
-                  f"host cores={os.cpu_count()}; single stream, "
-                  f"single thread]", file=sys.stderr)
+                  f"cap: {cap}; host cores={os.cpu_count()}; "
+                  f"single stream, single thread]", file=sys.stderr)
     except Exception as e:
         print(f"e2e bench skipped: {e}", file=sys.stderr)
 
@@ -222,13 +321,20 @@ def main() -> None:
     except Exception as e:
         print(f"streaming bench skipped: {e}", file=sys.stderr)
 
-    print(json.dumps({
+    result = {
         "metric": "tad_ewma_scoring_records_per_sec",
         "value": round(records_per_sec),
         "unit": "records/s",
         "vs_baseline": round(records_per_sec / BASELINE_RECORDS_PER_SEC,
                              1),
-    }))
+        "platform": dev.platform,
+        "e2e_ingest_rows_per_sec": round(e2e_rate),
+    }
+    if e2e_stages:
+        result["e2e_stages"] = e2e_stages
+    if dev.platform == "cpu":
+        result["degraded"] = "cpu fallback (accelerator unavailable)"
+    return result
 
 
 if __name__ == "__main__":
