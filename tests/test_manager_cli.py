@@ -253,6 +253,42 @@ def test_network_ingest_and_alerts(server):
     assert len(server.controller.db.flows) == n_now
 
 
+def test_ingest_connection_anomaly_alert(server):
+    """The north-star path: a wire-format throughput spike surfaces on
+    GET /alerts as a per-connection anomaly with decoded connection
+    identity and sub-second arrival→alert latency (BASELINE target;
+    the reference's TAD is a minutes-long batch job,
+    plugins/anomaly-detection/anomaly_detection.py)."""
+    from theia_tpu.ingest import BlockEncoder
+
+    cfg = SynthConfig(n_series=6, points_per_series=30,
+                      anomaly_fraction=1.0, anomaly_magnitude=80.0,
+                      seed=21)
+    enc = BlockEncoder()
+    batch = generate_flows(cfg, dicts=enc.dicts)
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/ingest?stream=spike",
+        method="POST", data=enc.encode(batch),
+        headers={"Content-Type": "application/octet-stream"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        out = json.loads(r.read())
+    assert out["rows"] == len(batch)
+    assert out["alerts"] > 0
+
+    doc = _get(server, "/alerts?limit=500")
+    conn = [a for a in doc["alerts"]
+            if a["kind"] == "connection_anomaly"]
+    assert conn, "expected per-connection anomaly alerts"
+    src_ips = set(batch.strings("sourceIP"))
+    for a in conn:
+        assert a["latency_s"] < 1.0, "sub-second alert latency"
+        assert a["sourceIP"] in src_ips      # decoded identity
+        assert isinstance(a["destinationIP"], str)
+        assert a["throughput"] > 0
+        assert "slot" in a and "flowEndSeconds" in a
+
+
 def test_ingest_stream_resets_on_failure(server):
     """A payload that fails decode resets its stream (a partially
     applied TSV decode would desync the dictionary chain); the stream

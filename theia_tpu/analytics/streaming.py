@@ -16,9 +16,15 @@ information available at arrival time (documented difference; the batch
 path remains available for parity).
 
 Slot model: a fixed-capacity state table indexed by slot; the host maps
-connection keys (tuples of dictionary codes) to slots on first sight.
-Capacity overflow evicts nothing — new series beyond capacity are
-dropped and counted, mirroring how a fixed-size flow cache degrades.
+connection keys (packed 6-tuples of dictionary codes) to slots on first
+sight. Capacity overflow evicts nothing — new series beyond capacity
+are dropped and counted, mirroring how a fixed-size flow cache degrades.
+
+Hot-path shape: one micro-batch is ONE jitted device step however many
+rows it carries. The step gathers only the U slots present in the batch,
+scans the (usually 1-2) ticks of duplicate points per connection over a
+[T, U] tile, and scatters the updated state back — O(T·U) device work
+instead of O(T·capacity) dense dispatches, with U ≤ rows.
 """
 
 from __future__ import annotations
@@ -51,17 +57,12 @@ def init_state(capacity: int, dtype=jnp.float32) -> StreamState:
                        mean=z, m2=z)
 
 
-@jax.jit
-def stream_update(state: StreamState, x: jnp.ndarray,
-                  active: jnp.ndarray,
-                  alpha: float = DEFAULT_ALPHA
-                  ) -> Tuple[StreamState, jnp.ndarray]:
-    """One micro-batch step: x [S] new values, active [S] validity.
-
-    Returns (new state, anomaly [S]): anomaly iff the slot is active,
-    has seen ≥2 points, and |x − ewma| exceeds the running sample
-    stddev (the streaming analogue of calculate_ewma_anomaly).
-    """
+def _update(state: StreamState, x: jnp.ndarray, active: jnp.ndarray,
+            alpha) -> Tuple[StreamState, jnp.ndarray]:
+    """Elementwise detector recurrence (any shape): anomaly iff the
+    slot is active, has seen ≥2 points, and |x − ewma| exceeds the
+    running sample stddev (the streaming analogue of
+    calculate_ewma_anomaly)."""
     xa = jnp.where(active, x, 0.0)
     count = state.count + active.astype(jnp.int32)
     delta = xa - state.mean
@@ -77,6 +78,55 @@ def stream_update(state: StreamState, x: jnp.ndarray,
     return StreamState(ewma, count, mean, m2), anomaly
 
 
+@jax.jit
+def stream_update(state: StreamState, x: jnp.ndarray,
+                  active: jnp.ndarray,
+                  alpha: float = DEFAULT_ALPHA
+                  ) -> Tuple[StreamState, jnp.ndarray]:
+    """Dense one-tick step: x [S] new values, active [S] validity."""
+    return _update(state, x, active, alpha)
+
+
+@jax.jit
+def stream_update_sparse(state: StreamState, slots: jnp.ndarray,
+                         x: jnp.ndarray, active: jnp.ndarray,
+                         alpha: float = DEFAULT_ALPHA
+                         ) -> Tuple[StreamState, jnp.ndarray]:
+    """Gather-scan-scatter step for one micro-batch.
+
+    slots [U] int32: the distinct state slots present in the batch;
+    padding entries hold `capacity` (out of bounds), so the gather
+    clamps harmlessly and the scatter DROPS them (XLA's documented
+    OOB semantics) — padded columns never touch real state.
+    x, active [T, U]: tick-major values; tick t carries each
+    connection's t-th point in this batch, so the recurrence sees
+    duplicate points in arrival order.
+
+    Returns (new state, anomaly [T, U]).
+    """
+    sub = StreamState(*(a[slots] for a in state))
+
+    def step(carry, inp):
+        x_t, act_t = inp
+        new, anomaly = _update(carry, x_t, act_t, alpha)
+        return new, anomaly
+
+    sub, anomalies = jax.lax.scan(step, sub, (x, active))
+    new_state = StreamState(*(
+        full.at[slots].set(part, mode="drop")
+        for full, part in zip(state, sub)))
+    return new_state, anomalies
+
+
+def _pad_pow2(n: int, minimum: int) -> int:
+    """Next power-of-two dispatch bucket so the jitted step compiles
+    once per bucket, not once per distinct micro-batch shape."""
+    size = minimum
+    while size < n:
+        size <<= 1
+    return size
+
+
 class StreamingDetector:
     """Host-side driver: key→slot mapping + device-resident state."""
 
@@ -87,11 +137,11 @@ class StreamingDetector:
         self.alpha = alpha
         self.value_column = value_column
         self.state = init_state(capacity)
-        # key → slot; dropped keys are remembered with slot -1 so a
-        # series is only counted dropped once, however many rows it
-        # keeps sending.
-        self._slots: Dict[Tuple[int, ...], int] = {}
-        self._slot_keys: List[Optional[Tuple[int, ...]]] = []
+        # packed key bytes → slot; dropped keys are remembered with
+        # slot -1 so a series is only counted dropped once, however
+        # many rows it keeps sending.
+        self._slots: Dict[bytes, int] = {}
+        self._slot_keys: List[Optional[bytes]] = []
         self._n_alloc = 0
         self.dropped_series = 0
 
@@ -99,7 +149,7 @@ class StreamingDetector:
     def n_series(self) -> int:
         return self._n_alloc
 
-    def _slot_for(self, key: Tuple[int, ...]) -> int:
+    def _slot_for(self, key: bytes) -> int:
         slot = self._slots.get(key)
         if slot is None:
             if self._n_alloc >= self.capacity:
@@ -117,70 +167,84 @@ class StreamingDetector:
 
         Rows are keyed by the 6-tuple connection columns; if a batch
         carries several points for one connection, each lands in a
-        successive tick so the recurrence sees them in order.
+        successive tick so the recurrence sees them in order. Python
+        work is O(distinct NEW connections), not O(rows): keys are
+        packed into 48-byte rows and deduplicated vectorized, and the
+        whole batch is one jitted gather-scan-scatter device step.
         """
         if len(batch) == 0:
             return []
         t_arrival = time.perf_counter()
-        keys = np.stack([np.asarray(batch[c], np.int64)
-                         for c in CONNECTION_KEY_COLUMNS], axis=1)
+        keys = np.ascontiguousarray(np.stack(
+            [np.asarray(batch[c], np.int64)
+             for c in CONNECTION_KEY_COLUMNS], axis=1))
         values = np.asarray(batch[self.value_column], np.float64)
         times = np.asarray(batch["flowEndSeconds"], np.int64)
 
-        slots = np.fromiter(
-            (self._slot_for(tuple(k)) for k in keys),
-            dtype=np.int64, count=keys.shape[0])
+        # Vectorized key→slot: dedupe packed key rows, then touch the
+        # Python dict once per distinct key (amortized: once per NEW
+        # key for a steady connection population).
+        packed = keys.view(np.dtype((np.void, keys.itemsize *
+                                     keys.shape[1]))).ravel()
+        uniq, inverse = np.unique(packed, return_inverse=True)
+        slots_u = np.fromiter(
+            (self._slot_for(k.tobytes()) for k in uniq),
+            dtype=np.int64, count=len(uniq))
+        slots = slots_u[inverse]
         ok = slots >= 0
 
         # Bucket duplicate slots into successive ticks (stable order).
         order = np.argsort(slots[ok], kind="stable")
         s_sorted = slots[ok][order]
         v_sorted = values[ok][order]
-        t_sorted = times[ok][order]
         idx_sorted = np.flatnonzero(ok)[order]
-        # tick index = occurrence number of this slot within the batch,
-        # computed vectorized (hot path): position minus the start index
-        # of the slot's run.
+        # tick index = occurrence number of this slot within the batch:
+        # position minus the start index of the slot's run.
         n = len(s_sorted)
         if n == 0:
-            tick = np.zeros(0, np.int64)
+            return []
+        same = np.empty(n, bool)
+        same[0] = False
+        same[1:] = s_sorted[1:] == s_sorted[:-1]
+        if not same.any():   # common case: one point per series
+            tick = np.zeros(n, np.int64)
         else:
-            same = np.empty(n, bool)
-            same[0] = False
-            same[1:] = s_sorted[1:] == s_sorted[:-1]
-            if not same.any():   # common case: one point per series
-                tick = np.zeros(n, np.int64)
-            else:
-                idx = np.arange(n)
-                run_start = np.maximum.accumulate(
-                    np.where(same, 0, idx))
-                tick = idx - run_start
-        n_ticks = int(tick.max()) + 1 if n else 0
+            idx = np.arange(n)
+            run_start = np.maximum.accumulate(np.where(same, 0, idx))
+            tick = idx - run_start
+        n_ticks = int(tick.max()) + 1
 
+        # [T, U] tile over the distinct slots present in this batch.
+        present, col = np.unique(s_sorted, return_inverse=True)
+        u = len(present)
+        u_pad = _pad_pow2(u, 64)
+        t_pad = _pad_pow2(n_ticks, 1)
+        x = np.zeros((t_pad, u_pad), np.float32)
+        active = np.zeros((t_pad, u_pad), bool)
+        row_idx = np.full((t_pad, u_pad), -1, np.int64)
+        x[tick, col] = v_sorted
+        active[tick, col] = True
+        row_idx[tick, col] = idx_sorted
+        slots_pad = np.full(u_pad, self.capacity, np.int32)
+        slots_pad[:u] = present
+        self.state, anomaly = stream_update_sparse(
+            self.state, jnp.asarray(slots_pad), jnp.asarray(x),
+            jnp.asarray(active), self.alpha)
+
+        hits = np.argwhere(np.asarray(anomaly))
+        if not hits.size:
+            return []
+        latency = time.perf_counter() - t_arrival
         alerts: List[Dict[str, object]] = []
-        for t in range(n_ticks):
-            sel = tick == t
-            x = np.zeros(self.capacity, np.float32)
-            active = np.zeros(self.capacity, bool)
-            x[s_sorted[sel]] = v_sorted[sel]
-            active[s_sorted[sel]] = True
-            self.state, anomaly = stream_update(
-                self.state, jnp.asarray(x), jnp.asarray(active),
-                self.alpha)
-            hit_slots = np.flatnonzero(np.asarray(anomaly))
-            if hit_slots.size:
-                latency = time.perf_counter() - t_arrival
-                row_for_slot = {int(s): int(i) for s, i in zip(
-                    s_sorted[sel], idx_sorted[sel])}
-                for slot in hit_slots:
-                    i = row_for_slot[int(slot)]
-                    alerts.append({
-                        "slot": int(slot),
-                        "row": i,
-                        "flowEndSeconds": int(times[i]),
-                        "throughput": float(values[i]),
-                        "latency_s": latency,
-                    })
+        for t, c in hits:
+            i = int(row_idx[t, c])
+            alerts.append({
+                "slot": int(present[c]),
+                "row": i,
+                "flowEndSeconds": int(times[i]),
+                "throughput": float(values[i]),
+                "latency_s": latency,
+            })
         return alerts
 
     def describe_alert(self, batch: ColumnarBatch,

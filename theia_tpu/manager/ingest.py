@@ -11,14 +11,20 @@ producers POST flow batches to the manager —
         response: {"rows": N, "alerts": K}
 
 Every ingested batch fans out to the store (materialized views, TTL)
-AND advances the streaming heavy-hitter / DDoS detector, whose alerts
-are served from a bounded ring:
+AND advances the streaming detectors — the heavy-hitter / DDoS sketch
+AND the per-connection EWMA anomaly engine — whose alerts are served
+from a bounded ring:
 
     GET /alerts?limit=N      most recent alerts, newest first
 
-The reference has no streaming alert surface at all — its analytics
-are batch jobs; this is the sub-second-path the BASELINE north star
-asks for, made reachable over the wire.
+Alert kinds: "heavy_hitter" / "ddos_shape" (volume + traffic-shape,
+analytics/heavy_hitters.py) and "connection_anomaly" (per-connection
+throughput spike with decoded connection identity and the arrival→alert
+latency_s, analytics/streaming.py). The reference has no streaming
+alert surface at all — its analytics are batch jobs
+(plugins/anomaly-detection/anomaly_detection.py); this is the
+sub-second path the BASELINE north star asks for, made reachable over
+the wire.
 """
 
 from __future__ import annotations
@@ -32,6 +38,7 @@ from typing import Deque, Dict, List, Optional
 import numpy as np
 
 from ..analytics.heavy_hitters import HeavyHitterDetector
+from ..analytics.streaming import StreamingDetector
 from ..ingest.native import BLOCK_MAGIC, BLOCK_MAGIC_V1, TsvDecoder
 from ..schema import ColumnarBatch, StringDictionary
 from ..utils import get_logger
@@ -81,12 +88,17 @@ class IngestManager:
     #: streams idle longer than this may be evicted to admit new ones
     IDLE_EVICT_SECONDS = 300.0
 
-    def __init__(self, db, detector: Optional[HeavyHitterDetector] = None
-                 ) -> None:
+    #: string key columns remapped to ingest-global codes before
+    #: scoring (both detectors key on them; see _global_codes)
+    GLOBAL_COLUMNS = ("sourceIP", "destinationIP")
+
+    def __init__(self, db, detector: Optional[HeavyHitterDetector] = None,
+                 streaming: Optional[StreamingDetector] = None) -> None:
         self.db = db
         self._streams: Dict[str, _Stream] = {}
         self._registry_lock = threading.Lock()
         self.detector = detector or HeavyHitterDetector()
+        self.streaming = streaming or StreamingDetector()
         # Detector state (device compute) and the alert ring have
         # separate locks: GET /alerts only touches the cheap ring lock,
         # never waiting behind scoring or JIT compilation.
@@ -96,13 +108,17 @@ class IngestManager:
             maxlen=MAX_ALERTS)
         self.rows_ingested = 0
         # Detector keys must be stable across streams and stream
-        # resets; stream-local dictionary codes are neither, so
-        # destinations re-encode against this ingest-global dictionary
-        # before scoring. The re-encode is an int32 code remap through
-        # a cached per-source-dictionary mapping (extended only for
-        # newly minted entries) — no string objects on the hot path.
-        self._dst_dict = StringDictionary()
-        self._dst_maps: Dict[int, tuple] = {}   # id(src) → (src, map)
+        # resets; stream-local dictionary codes are neither, so the
+        # key columns re-encode against these ingest-global
+        # dictionaries before scoring. The re-encode is an int32 code
+        # remap through a cached per-source-dictionary mapping
+        # (extended only for newly minted entries) — no string objects
+        # on the hot path.
+        self._global_dicts: Dict[str, StringDictionary] = {
+            c: StringDictionary() for c in self.GLOBAL_COLUMNS}
+        # column → {id(src dict) → (src ref, int32 map)}
+        self._code_maps: Dict[str, Dict[int, tuple]] = {
+            c: {} for c in self.GLOBAL_COLUMNS}
 
     def _stream(self, stream_id: str) -> _Stream:
         with self._registry_lock:
@@ -168,37 +184,53 @@ class IngestManager:
                 raise
         n = self.db.insert_flows(batch)
         with self._detector_lock:
-            # Re-encode destinations against the ingest-global
-            # dictionary: CMS keys persist across batches, so they must
-            # mean the same destination whichever stream (or stream
+            # Re-encode the string key columns against the
+            # ingest-global dictionaries: detector state (CMS counts,
+            # per-connection slots) persists across batches, so keys
+            # must mean the same endpoint whichever stream (or stream
             # generation) produced the batch.
-            gcodes = self._global_dst_codes(batch)
             scored = ColumnarBatch(
-                {**batch.columns, "destinationIP": gcodes},
-                {**batch.dicts, "destinationIP": self._dst_dict})
+                {**batch.columns,
+                 **{c: self._global_codes(c, batch)
+                    for c in self.GLOBAL_COLUMNS}},
+                {**batch.dicts,
+                 **{c: self._global_dicts[c]
+                    for c in self.GLOBAL_COLUMNS}})
             alerts = self.detector.update(scored)
+            conn_alerts = []
+            for a in self.streaming.ingest(scored):
+                described = self.streaming.describe_alert(scored, a)
+                # "row" is batch-local; meaningless once published
+                described.pop("row", None)
+                described["kind"] = "connection_anomaly"
+                conn_alerts.append(described)
         now = time.time()
+        n_alerts = len(alerts) + len(conn_alerts)
         with self._alerts_lock:
             for a in alerts:
                 self._alerts.appendleft(
                     {**dataclasses.asdict(a), "time": now})
+            for d in conn_alerts:
+                self._alerts.appendleft({**d, "time": now})
             self.rows_ingested += n
-        if alerts:
-            logger.v(1).info("ingested %d rows, %d alerts", n,
-                             len(alerts))
-        return {"rows": n, "alerts": len(alerts)}
+        if n_alerts:
+            logger.v(1).info("ingested %d rows, %d alerts", n, n_alerts)
+        return {"rows": n, "alerts": n_alerts}
 
-    def _global_dst_codes(self, batch: ColumnarBatch) -> np.ndarray:
-        """Map the batch's stream-local destinationIP codes onto the
+    def _global_codes(self, column: str,
+                      batch: ColumnarBatch) -> np.ndarray:
+        """Map the batch's stream-local codes for `column` onto the
         ingest-global dictionary via a cached int32 mapping (amortized
         O(new dictionary entries), not O(rows) string work). Caller
         holds the detector lock. Keeps a strong reference to each
         source dictionary so an id() can never be reused while its
         mapping is cached (streams are bounded by MAX_STREAMS)."""
-        src = batch.dicts["destinationIP"]
-        entry = self._dst_maps.pop(id(src), None)
+        src = batch.dicts[column]
+        maps = self._code_maps[column]
+        gdict = self._global_dicts[column]
+        entry = maps.pop(id(src), None)
         if entry is None or entry[0] is not src:
-            if len(self._dst_maps) >= 2 * MAX_STREAMS:
+            if len(maps) >= 2 * MAX_STREAMS:
                 # Stream resets mint new dictionaries; drop the
                 # least-recently-used mappings so reset churn can't
                 # grow this unboundedly. Every lookup re-inserts its
@@ -206,18 +238,18 @@ class IngestManager:
                 # IS recency order and the front of the dict holds the
                 # coldest entries — reset-orphaned dictionaries age to
                 # the front, active streams stay at the back.
-                for stale in list(self._dst_maps)[:MAX_STREAMS]:
-                    del self._dst_maps[stale]
+                for stale in list(maps)[:MAX_STREAMS]:
+                    del maps[stale]
             entry = (src, np.zeros(0, np.int32))
         src_ref, mapping = entry
         if len(mapping) < len(src):
             new = np.fromiter(
-                (self._dst_dict.encode_one(s)
+                (gdict.encode_one(s)
                  for s in src.entries_since(len(mapping))),
                 dtype=np.int32)
             mapping = np.concatenate([mapping, new])
-        self._dst_maps[id(src)] = (src_ref, mapping)
-        return mapping[np.asarray(batch["destinationIP"], np.int64)]
+        maps[id(src)] = (src_ref, mapping)
+        return mapping[np.asarray(batch[column], np.int64)]
 
     def recent_alerts(self, limit: int = 100) -> List[Dict[str, object]]:
         with self._alerts_lock:
