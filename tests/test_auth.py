@@ -1,0 +1,143 @@
+"""API authentication: bearer-token enforcement + CLI plumbing.
+
+The reference delegates authn/authz to kube-apiserver
+(cmd/theia-manager/theia-manager.go:60-83) and its CLI sends a
+ServiceAccount bearer token (pkg/theia/commands/utils.go:122-144);
+the equivalent here is a static bearer token enforced on every
+mutating, ingest, and support-bundle endpoint.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from theia_tpu.cli.__main__ import main as cli_main
+from theia_tpu.data.synth import SynthConfig, generate_flows
+from theia_tpu.manager import TheiaManagerServer
+from theia_tpu.manager.api import resolve_auth_token
+from theia_tpu.store import FlowDatabase
+
+GROUP = "/apis/intelligence.theia.antrea.io/v1alpha1"
+TOKEN = "test-token-123"
+
+
+@pytest.fixture()
+def auth_server():
+    db = FlowDatabase()
+    db.insert_flows(generate_flows(SynthConfig(
+        n_series=4, points_per_series=10, seed=2)))
+    srv = TheiaManagerServer(db, port=0, auth_token=TOKEN)
+    srv.start_background()
+    yield srv
+    srv.shutdown()
+
+
+def _call(srv, method, path, body=None, token=None, raw=None):
+    headers = {}
+    if token is not None:
+        headers["Authorization"] = f"Bearer {token}"
+    data = raw if raw is not None else (
+        json.dumps(body).encode() if body is not None else None)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}{path}", method=method,
+        data=data, headers=headers)
+    with urllib.request.urlopen(req, timeout=10) as r:
+        payload = r.read()
+        return r.status, json.loads(payload) if payload else {}
+
+
+def _status_of(call):
+    try:
+        return call()[0]
+    except urllib.error.HTTPError as e:
+        return e.code
+
+
+def test_missing_token_is_401(auth_server):
+    code = _status_of(lambda: _call(
+        auth_server, "POST", f"{GROUP}/throughputanomalydetectors",
+        body={"jobType": "EWMA"}))
+    assert code == 401
+
+
+def test_wrong_token_is_403(auth_server):
+    code = _status_of(lambda: _call(
+        auth_server, "POST", f"{GROUP}/throughputanomalydetectors",
+        body={"jobType": "EWMA"}, token="wrong"))
+    assert code == 403
+
+
+def test_delete_and_ingest_and_bundle_require_token(auth_server):
+    assert _status_of(lambda: _call(
+        auth_server, "DELETE",
+        f"{GROUP}/throughputanomalydetectors/tad-x")) == 401
+    assert _status_of(lambda: _call(
+        auth_server, "POST", "/ingest", raw=b"x")) == 401
+    # bundle status/download are read-path exfiltration: also guarded
+    assert _status_of(lambda: _call(
+        auth_server, "GET",
+        "/apis/system.theia.antrea.io/v1alpha1/supportbundles")) == 401
+    assert _status_of(lambda: _call(
+        auth_server, "POST",
+        "/apis/system.theia.antrea.io/v1alpha1/supportbundles",
+        token="bad")) == 403
+
+
+def test_read_paths_stay_open(auth_server):
+    # healthz/version/stats/alerts/job GETs are the Grafana-style
+    # read path (reference Grafana reads ClickHouse directly,
+    # values.yaml:38-40) — no token needed.
+    for path in ("/healthz", "/version", "/alerts",
+                 "/apis/stats.theia.antrea.io/v1alpha1/clickhouse",
+                 f"{GROUP}/throughputanomalydetectors"):
+        code, _ = _call(auth_server, "GET", path)
+        assert code == 200, path
+
+
+def test_correct_token_admits_job_lifecycle(auth_server):
+    code, doc = _call(auth_server, "POST",
+                      f"{GROUP}/throughputanomalydetectors",
+                      body={"jobType": "EWMA"}, token=TOKEN)
+    assert code == 201
+    name = doc["metadata"]["name"]
+    assert auth_server.controller.wait_all()
+    code, got = _call(auth_server, "GET",
+                      f"{GROUP}/throughputanomalydetectors/{name}")
+    assert got["status"]["state"] == "COMPLETED"
+    code, _ = _call(auth_server, "DELETE",
+                    f"{GROUP}/throughputanomalydetectors/{name}",
+                    token=TOKEN)
+    assert code == 200
+
+
+def test_cli_token_flag_and_file(auth_server, tmp_path, capsys):
+    addr = ["--manager-addr", f"http://127.0.0.1:{auth_server.port}"]
+    # without a token the mutating CLI call fails with the 401 message
+    with pytest.raises(SystemExit, match="401"):
+        cli_main(addr + ["tad", "run", "--algo", "EWMA"])
+    capsys.readouterr()
+    cli_main(addr + ["--token", TOKEN,
+                     "tad", "run", "--algo", "EWMA", "--wait"])
+    assert "Successfully started" in capsys.readouterr().out
+
+    tf = tmp_path / "token"
+    tf.write_text(TOKEN + "\n")
+    cli_main(addr + ["--token-file", str(tf), "pr", "run", "--wait"])
+    assert "kind: NetworkPolicy" in capsys.readouterr().out
+
+
+def test_resolve_auth_token_generates_file(tmp_path):
+    path = tmp_path / "auth" / "token"
+    path.parent.mkdir()
+    token = resolve_auth_token(None, str(path))
+    assert token and len(token) == 64
+    # idempotent: second resolve reads the same token back
+    assert resolve_auth_token(None, str(path)) == token
+    import os
+    assert (os.stat(path).st_mode & 0o777) == 0o600
+    # explicit token wins over the file
+    assert resolve_auth_token("explicit", str(path)) == "explicit"
+    # neither → auth off
+    assert resolve_auth_token(None, None) is None

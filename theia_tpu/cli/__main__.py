@@ -23,6 +23,7 @@ from __future__ import annotations
 import argparse
 import datetime
 import json
+import os
 import sys
 import time
 import urllib.error
@@ -55,6 +56,7 @@ class APIError(SystemExit):
 
 
 _CA_CERT = ""
+_TOKEN = ""
 
 
 def _url_context():
@@ -64,12 +66,20 @@ def _url_context():
     return ssl.create_default_context(cafile=_CA_CERT)
 
 
+def _auth_headers() -> Dict[str, str]:
+    """Bearer token for an authenticated manager (the reference CLI
+    reads a ServiceAccount token Secret and sends it the same way,
+    pkg/theia/commands/utils.go:122-144)."""
+    return {"Authorization": f"Bearer {_TOKEN}"} if _TOKEN else {}
+
+
 def _request(addr: str, method: str, path: str,
              body: Optional[Dict] = None) -> Dict:
     req = urllib.request.Request(
         addr + path, method=method,
         data=json.dumps(body).encode() if body is not None else None,
-        headers={"Content-Type": "application/json"})
+        headers={"Content-Type": "application/json",
+                 **_auth_headers()})
     try:
         with urllib.request.urlopen(req, timeout=30,
                                     context=_url_context()) as resp:
@@ -401,7 +411,8 @@ def supportbundle(args) -> None:
     else:
         raise APIError("error: support bundle collection timed out")
     req = urllib.request.Request(
-        args.manager_addr + path + "/theia-manager/download")
+        args.manager_addr + path + "/theia-manager/download",
+        headers=_auth_headers())
     with urllib.request.urlopen(req, timeout=60,
                                 context=_url_context()) as resp:
         data = resp.read()
@@ -432,6 +443,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ca-cert", default="",
                    help="CA certificate for a TLS manager (the "
                         "published theia-ca.crt)")
+    p.add_argument("--token", default=os.environ.get("THEIA_TOKEN", ""),
+                   help="API bearer token (env THEIA_TOKEN); required "
+                        "for mutating calls on an authenticated "
+                        "manager")
+    p.add_argument("--token-file", default="",
+                   help="read the API bearer token from this file "
+                        "(e.g. the manager's --auth-token-file)")
     p.add_argument("-v", "--verbosity", type=int, default=0,
                    help="log verbosity (klog-style)")
     sub = p.add_subparsers(dest="command", required=True)
@@ -573,9 +591,14 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> None:
-    global _CA_CERT
+    global _CA_CERT, _TOKEN
     args = build_parser().parse_args(argv)
     _CA_CERT = getattr(args, "ca_cert", "") or ""
+    _TOKEN = getattr(args, "token", "") or ""
+    token_file = getattr(args, "token_file", "") or ""
+    if not _TOKEN and token_file:
+        with open(token_file) as f:
+            _TOKEN = f.read().strip()
     from ..utils import set_verbosity
     set_verbosity(getattr(args, "verbosity", 0))
     try:

@@ -4,7 +4,7 @@ Usage:
   python -m theia_tpu.manager [--db flows.npz] [--port 11347]
       [--address 0.0.0.0] [--capacity-bytes N] [--ttl-seconds N]
       [--synth N_SERIES] [--tls-cert-dir DIR [--tls-cert F --tls-key F
-      [--tls-ca F]]]
+      [--tls-ca F]]] [--auth-token-file F | --auth-token T]
 
 --synth seeds the store with synthetic flows (demo/e2e); --db loads a
 persisted FlowDatabase (and persists results back on shutdown). TTL can
@@ -49,6 +49,13 @@ def main(argv=None) -> None:
     p.add_argument("--tls-key", default=None)
     p.add_argument("--tls-ca", default=None,
                    help="issuing CA bundle to publish for provided certs")
+    p.add_argument("--auth-token", default=None,
+                   help="require this API bearer token on mutating/"
+                        "ingest/bundle endpoints (env THEIA_AUTH_TOKEN)")
+    p.add_argument("--auth-token-file", default=None,
+                   help="require the bearer token stored here; a fresh "
+                        "random token is generated into the file if "
+                        "absent (mode 0600)")
     args = p.parse_args(argv)
 
     from ..store import FlowDatabase, ShardedFlowDatabase
@@ -73,7 +80,12 @@ def main(argv=None) -> None:
                 "selfSignedCert" in api_conf or "tlsCertDir" in api_conf):
             args.tls_cert_dir = str(
                 api_conf.get("tlsCertDir", "/var/run/theia/tls"))
+        if args.auth_token_file is None and "authTokenFile" in api_conf:
+            args.auth_token_file = str(api_conf["authTokenFile"])
         log.v(1).info("loaded config from %s", args.config)
+
+    if args.auth_token is None:
+        args.auth_token = os.environ.get("THEIA_AUTH_TOKEN") or None
 
     from ..utils import env_int
     ttl = args.ttl_seconds
@@ -106,7 +118,12 @@ def main(argv=None) -> None:
         workers=args.workers, capacity_bytes=args.capacity_bytes,
         address=args.address,
         tls_cert_dir=args.tls_cert_dir, tls_cert=args.tls_cert,
-        tls_key=args.tls_key, tls_ca=args.tls_ca)
+        tls_key=args.tls_key, tls_ca=args.tls_ca,
+        auth_token=args.auth_token,
+        auth_token_file=args.auth_token_file)
+    if server.auth_token:
+        print("API authentication enabled (bearer token)",
+              file=sys.stderr)
     if server.ca_cert_path:
         print(f"CA certificate published at {server.ca_cert_path}",
               file=sys.stderr)

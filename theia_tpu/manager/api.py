@@ -18,6 +18,19 @@ Serialization is the same JSON shape the reference's k8s types marshal
 to (pkg/apis/intelligence/v1alpha1/types.go), so the CLI talks to either
 server. Transport is plain HTTP on a ThreadingHTTPServer; the
 reference's delegated authn/TLS sits in front of an equivalent seam.
+
+Authentication: the reference delegates authn/authz to kube-apiserver
+(cmd/theia-manager/theia-manager.go:60-83) and the CLI sends a
+ServiceAccount bearer token (pkg/theia/commands/utils.go:122-144). The
+equivalent here is a static bearer token (`auth_token`): when set,
+every request that can mutate state or exfiltrate data — POST (job
+create, /ingest, bundle collect), DELETE, and the system group's
+bundle status/download — must carry `Authorization: Bearer <token>`.
+A missing/malformed header is 401 (unauthenticated); a well-formed but
+wrong token is 403 (unauthorized). Read-only observability (healthz,
+version, stats, dashboards, alerts, job GETs) stays open, playing the
+role of the reference's unauthenticated Grafana read path (Grafana
+queries ClickHouse directly, values.yaml:38-40).
 """
 
 from __future__ import annotations
@@ -45,6 +58,15 @@ from ..utils import dump_logs, get_logger
 logger = get_logger("apiserver")
 
 API_PORT = 11347
+
+
+class AuthError(Exception):
+    """Request failed authentication (code 401) or authorization
+    (code 403)."""
+
+    def __init__(self, code: int, message: str) -> None:
+        super().__init__(message)
+        self.code = code
 
 GROUP_INTELLIGENCE = "/apis/intelligence.theia.antrea.io/v1alpha1"
 GROUP_STATS = "/apis/stats.theia.antrea.io/v1alpha1"
@@ -159,6 +181,7 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
     stats: StatsProvider
     bundles: SupportBundleManager
     ingest = None   # IngestManager
+    auth_token: Optional[str] = None
     quiet = True
     # Socket timeout (StreamRequestHandler honors it): a client that
     # declares a Content-Length then stalls mid-body would otherwise
@@ -183,6 +206,33 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
     def _send_error_json(self, code: int, message: str) -> None:
         self._send_json({"kind": "Status", "status": "Failure",
                          "message": message, "code": code}, code)
+
+    def _require_auth(self) -> None:
+        """Enforce the static bearer token (no-op when auth is off).
+        Constant-time comparison; 401 for absent/malformed
+        Authorization, 403 for a wrong token."""
+        if self.auth_token is None:
+            return
+        import hmac
+        header = self.headers.get("Authorization", "")
+        if not header.startswith("Bearer "):
+            raise AuthError(
+                401, "missing or malformed Authorization header "
+                     "(expected: Bearer <token>)")
+        token = header[len("Bearer "):].strip()
+        if not hmac.compare_digest(token, self.auth_token):
+            raise AuthError(403, "invalid bearer token")
+
+    def _send_auth_error(self, e: AuthError) -> None:
+        raw = json.dumps({"kind": "Status", "status": "Failure",
+                          "message": str(e), "code": e.code}).encode()
+        self.send_response(e.code)
+        if e.code == 401:
+            self.send_header("WWW-Authenticate", "Bearer")
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(raw)))
+        self.end_headers()
+        self.wfile.write(raw)
 
     # 256 MiB: bounds what one request can make the server buffer.
     MAX_BODY_BYTES = 256 << 20
@@ -219,6 +269,8 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
     def do_GET(self) -> None:  # noqa: N802
         try:
             self._get()
+        except AuthError as e:
+            self._send_auth_error(e)
         except KeyError:
             self._send_error_json(404, f"not found: {self.path}")
         except ValueError as e:  # malformed query params are the
@@ -229,7 +281,10 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
     def do_POST(self) -> None:  # noqa: N802
         from .ingest import StreamCapacityError
         try:
+            self._require_auth()   # every POST mutates state
             self._post()
+        except AuthError as e:
+            self._send_auth_error(e)
         except DuplicateJobError as e:
             self._send_error_json(409, str(e))
         except StreamCapacityError as e:
@@ -244,7 +299,10 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
 
     def do_DELETE(self) -> None:  # noqa: N802
         try:
+            self._require_auth()   # every DELETE mutates state
             self._delete()
+        except AuthError as e:
+            self._send_auth_error(e)
         except KeyError:
             self._send_error_json(404, f"not found: {self.path}")
         except Exception as e:
@@ -356,6 +414,10 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
         self._send_json(doc)
 
     def _get_system(self, parts) -> None:
+        # Bundles carry logs/stats/job specs — exfiltration surface, so
+        # even their GETs require the token (reference bundles sit
+        # behind the aggregated apiserver's delegated authn).
+        self._require_auth()
         if len(parts) >= 4 and parts[3] == "supportbundles":
             if len(parts) == 6 and parts[5] == "download":
                 data = self.bundles.data()
@@ -409,6 +471,35 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
         raise KeyError(self.path)
 
 
+def resolve_auth_token(auth_token: Optional[str],
+                       auth_token_file: Optional[str]) -> Optional[str]:
+    """An explicit token wins; else read the token file, minting a
+    fresh random token into it when absent (the deployment analogue of
+    the reference's ServiceAccount token Secret, which kube generates
+    and the CLI reads — pkg/theia/commands/utils.go:122-144). Returns
+    None (auth off) only when neither source is configured."""
+    if auth_token:
+        return auth_token
+    if not auth_token_file:
+        return None
+    import os
+    import secrets
+    try:
+        with open(auth_token_file) as f:
+            token = f.read().strip()
+        if token:
+            return token
+    except FileNotFoundError:
+        pass
+    token = secrets.token_hex(32)
+    fd = os.open(auth_token_file,
+                 os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "w") as f:
+        f.write(token + "\n")
+    logger.info("generated API bearer token at %s", auth_token_file)
+    return token
+
+
 class _TLSCapableServer(ThreadingHTTPServer):
     """HTTP server that performs the TLS handshake per connection on
     the worker thread — wrapping the *listening* socket would run the
@@ -436,18 +527,23 @@ class TheiaManagerServer:
                  tls_cert_dir: Optional[str] = None,
                  tls_cert: Optional[str] = None,
                  tls_key: Optional[str] = None,
-                 tls_ca: Optional[str] = None) -> None:
+                 tls_ca: Optional[str] = None,
+                 auth_token: Optional[str] = None,
+                 auth_token_file: Optional[str] = None) -> None:
         from .ingest import IngestManager
         self.controller = JobController(db, workers=workers)
         self.stats = StatsProvider(db, capacity_bytes=capacity_bytes)
         self.bundles = SupportBundleManager(self.controller, self.stats)
         self.ingest = IngestManager(db)
+        self.auth_token = resolve_auth_token(auth_token,
+                                             auth_token_file)
 
         handler = type("BoundHandler", (ManagerAPIHandler,), {
             "controller": self.controller,
             "stats": self.stats,
             "bundles": self.bundles,
             "ingest": self.ingest,
+            "auth_token": self.auth_token,
         })
         self.httpd = _TLSCapableServer((address, port), handler)
         self.ca_cert_path: Optional[str] = None
