@@ -1,0 +1,197 @@
+"""Out-of-process job dispatch: runner children, progress scrape,
+crash isolation.
+
+The reference isolates analytics in Spark driver/executor pods and
+scrapes progress over REST (pkg/controller/util.go:129-159,223-293);
+here each job is a `python -m theia_tpu.runner` child over a database
+snapshot. The contract under test: a completing child's results merge
+back; a child killed with SIGKILL fails the JOB record while the
+manager stays alive and serves the next job.
+"""
+
+import os
+import signal
+import sys
+import time
+
+import pytest
+
+from theia_tpu.data.synth import SynthConfig, generate_flows
+from theia_tpu.manager.jobs import (
+    KIND_NPR,
+    KIND_TAD,
+    STATE_COMPLETED,
+    STATE_FAILED,
+    STATE_RUNNING,
+    JobController,
+)
+from theia_tpu.store import FlowDatabase
+
+
+@pytest.fixture()
+def db():
+    d = FlowDatabase()
+    d.insert_flows(generate_flows(SynthConfig(
+        n_series=8, points_per_series=20, anomaly_fraction=0.4,
+        anomaly_magnitude=60.0, seed=11)))
+    return d
+
+
+def test_subprocess_tad_job_completes_and_merges(db):
+    ctl = JobController(db, workers=1, dispatch="subprocess")
+    try:
+        record = ctl.create(KIND_TAD, {"jobType": "EWMA"})
+        assert ctl.wait_all(timeout=120)
+        assert record.state == STATE_COMPLETED, record.error_msg
+        assert record.runner_pid > 0
+        # results merged back into the LIVE db from the snapshot
+        stats = ctl.tad_stats(record.name)
+        assert stats and all(s["algoType"] == "EWMA" for s in stats)
+        # progress was scraped from the child's --progress-file
+        snap = record.progress.snapshot()
+        assert snap["completedStages"] == snap["totalStages"] == 4
+    finally:
+        ctl.shutdown()
+
+
+def test_subprocess_npr_job_completes(db):
+    ctl = JobController(db, workers=1, dispatch="subprocess")
+    try:
+        record = ctl.create(KIND_NPR, {"jobType": "initial",
+                                       "policyType": "anp-deny-applied"})
+        assert ctl.wait_all(timeout=120)
+        assert record.state == STATE_COMPLETED, record.error_msg
+        outcome = ctl.recommendation_outcome(record.name)
+        assert "kind: NetworkPolicy" in outcome
+    finally:
+        ctl.shutdown()
+
+
+def test_invalid_spec_fails_before_spawn(db):
+    ctl = JobController(db, workers=1, dispatch="subprocess")
+    try:
+        record = ctl.create(KIND_NPR, {"policyType": "bogus"})
+        assert ctl.wait_all(timeout=30)
+        assert record.state == STATE_FAILED
+        assert "policyType" in record.error_msg
+        assert record.runner_pid == 0   # no child was ever spawned
+    finally:
+        ctl.shutdown()
+
+
+def test_sigkilled_runner_fails_job_not_manager(db, monkeypatch):
+    """kill -9 on the running child: record goes FAILED with a signal
+    message, and the controller immediately runs the NEXT job fine."""
+    ctl = JobController(db, workers=1, dispatch="subprocess")
+    # deterministic long-running child (the real runner's runtime is
+    # dominated by interpreter+jax startup, racy to kill mid-compute)
+    monkeypatch.setattr(
+        ctl, "_runner_cmd",
+        lambda record, snap, prog: [sys.executable, "-c",
+                                    "import time; time.sleep(120)"])
+    try:
+        record = ctl.create(KIND_TAD, {"jobType": "EWMA"})
+        deadline = time.time() + 30
+        while record.runner_pid == 0 and time.time() < deadline:
+            time.sleep(0.05)
+        assert record.runner_pid > 0
+        assert record.state == STATE_RUNNING
+        os.kill(record.runner_pid, signal.SIGKILL)
+        assert ctl.wait_all(timeout=30)
+        assert record.state == STATE_FAILED
+        assert "signal 9" in record.error_msg
+
+        # the manager-side controller survived: next job succeeds
+        monkeypatch.undo()
+        record2 = ctl.create(KIND_TAD, {"jobType": "EWMA"})
+        assert ctl.wait_all(timeout=120)
+        assert record2.state == STATE_COMPLETED, record2.error_msg
+    finally:
+        ctl.shutdown()
+
+
+def test_delete_cancels_running_subprocess(db, monkeypatch):
+    ctl = JobController(db, workers=1, dispatch="subprocess")
+    monkeypatch.setattr(
+        ctl, "_runner_cmd",
+        lambda record, snap, prog: [sys.executable, "-c",
+                                    "import time; time.sleep(120)"])
+    try:
+        record = ctl.create(KIND_TAD, {"jobType": "EWMA"})
+        deadline = time.time() + 30
+        while record.runner_pid == 0 and time.time() < deadline:
+            time.sleep(0.05)
+        ctl.delete(record.name)
+        # deleted records leave wait_all's view; poll the record itself
+        deadline = time.time() + 30
+        while record.state == STATE_RUNNING and time.time() < deadline:
+            time.sleep(0.05)
+        # the child was killed rather than left running for 120 s
+        assert record.state in (STATE_FAILED, STATE_COMPLETED)
+        with pytest.raises(OSError):
+            os.kill(record.runner_pid, 0)   # pid gone (or reaped)
+    finally:
+        ctl.shutdown()
+
+
+def test_delete_then_recreate_same_name_kills_old_child(db,
+                                                        monkeypatch):
+    """Delete + immediate same-name recreate: the OLD child must still
+    be cancelled (record identity, not name, decides) and must not
+    leak results into the recreated job."""
+    ctl = JobController(db, workers=1, dispatch="subprocess")
+    monkeypatch.setattr(
+        ctl, "_runner_cmd",
+        lambda record, snap, prog: [sys.executable, "-c",
+                                    "import time; time.sleep(120)"])
+    try:
+        name = "tad-aaaaaaaa-bbbb-cccc-dddd-eeeeeeeeeeee"
+        record = ctl.create(KIND_TAD, {"jobType": "EWMA"}, name=name)
+        deadline = time.time() + 30
+        while record.runner_pid == 0 and time.time() < deadline:
+            time.sleep(0.05)
+        old_pid = record.runner_pid
+        ctl.delete(name)
+        record2 = ctl.create(KIND_TAD, {"jobType": "EWMA"}, name=name)
+        # old child dies even though the name exists again
+        deadline = time.time() + 30
+        while record.state == STATE_RUNNING and time.time() < deadline:
+            time.sleep(0.05)
+        assert record.state == STATE_FAILED
+        with pytest.raises(OSError):
+            os.kill(old_pid, 0)
+        assert record2 is not record
+    finally:
+        ctl.shutdown()
+
+
+def test_device_serialization_one_child_at_a_time(db, monkeypatch,
+                                                  tmp_path):
+    """Two queued jobs with 2 workers must NOT run children
+    concurrently — the device lock serializes accelerator access.
+    Each child stamps its own start time; serialized execution means
+    the stamps are >= the 1 s child runtime apart."""
+    ctl = JobController(db, workers=2, dispatch="subprocess")
+    stamps = tmp_path / "stamps"
+    stamps.mkdir()
+    code = ("import time, sys; "
+            "open(sys.argv[1], 'w').write(str(time.time())); "
+            "time.sleep(1.0)")
+    calls = []
+
+    def fake_cmd(record, snap, prog):
+        calls.append(record.name)
+        return [sys.executable, "-c", code,
+                str(stamps / f"start-{len(calls)}")]
+
+    monkeypatch.setattr(ctl, "_runner_cmd", fake_cmd)
+    try:
+        ctl.create(KIND_TAD, {"jobType": "EWMA"})
+        ctl.create(KIND_TAD, {"jobType": "EWMA"})
+        assert ctl.wait_all(timeout=60)
+        starts = sorted(float(p.read_text())
+                        for p in stamps.iterdir())
+        assert len(starts) == 2
+        assert starts[1] - starts[0] >= 0.9
+    finally:
+        ctl.shutdown()

@@ -597,8 +597,12 @@ def main(argv=None) -> None:
     _TOKEN = getattr(args, "token", "") or ""
     token_file = getattr(args, "token_file", "") or ""
     if not _TOKEN and token_file:
-        with open(token_file) as f:
-            _TOKEN = f.read().strip()
+        try:
+            with open(token_file) as f:
+                _TOKEN = f.read().strip()
+        except OSError as e:
+            raise APIError(
+                f"error: cannot read token file {token_file}: {e}")
     from ..utils import set_verbosity
     set_verbosity(getattr(args, "verbosity", 0))
     try:

@@ -37,6 +37,13 @@ def main(argv=None) -> None:
     p.add_argument("--ttl-seconds", type=int, default=None,
                    help="flow TTL; default THEIA_TTL_SECONDS env or off")
     p.add_argument("--workers", type=int, default=2)
+    p.add_argument("--dispatch", default="thread",
+                   choices=["thread", "subprocess"],
+                   help="job execution: in-process worker threads, or "
+                        "one `python -m theia_tpu.runner` child per "
+                        "job (process isolation — a crashing kernel "
+                        "fails the JOB, not the manager; the "
+                        "reference's Spark driver/executor boundary)")
     p.add_argument("--synth", type=int, default=0,
                    help="seed the store with N synthetic series")
     p.add_argument("--shards", type=int, default=1,
@@ -116,7 +123,7 @@ def main(argv=None) -> None:
     server = TheiaManagerServer(
         db, port=args.port if args.port is not None else API_PORT,
         workers=args.workers, capacity_bytes=args.capacity_bytes,
-        address=args.address,
+        address=args.address, dispatch=args.dispatch,
         tls_cert_dir=args.tls_cert_dir, tls_cert=args.tls_cert,
         tls_key=args.tls_key, tls_ca=args.tls_ca,
         auth_token=args.auth_token,

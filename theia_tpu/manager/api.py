@@ -220,7 +220,10 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
                 401, "missing or malformed Authorization header "
                      "(expected: Bearer <token>)")
         token = header[len("Bearer "):].strip()
-        if not hmac.compare_digest(token, self.auth_token):
+        # compare bytes: compare_digest raises on non-ASCII str input,
+        # which would turn a hostile token into a 500
+        if not hmac.compare_digest(token.encode(),
+                                   self.auth_token.encode()):
             raise AuthError(403, "invalid bearer token")
 
     def _send_auth_error(self, e: AuthError) -> None:
@@ -524,6 +527,7 @@ class TheiaManagerServer:
     def __init__(self, db, port: int = API_PORT, workers: int = 2,
                  capacity_bytes: int = 8 << 30,
                  address: str = "127.0.0.1",
+                 dispatch: str = "thread",
                  tls_cert_dir: Optional[str] = None,
                  tls_cert: Optional[str] = None,
                  tls_key: Optional[str] = None,
@@ -531,7 +535,8 @@ class TheiaManagerServer:
                  auth_token: Optional[str] = None,
                  auth_token_file: Optional[str] = None) -> None:
         from .ingest import IngestManager
-        self.controller = JobController(db, workers=workers)
+        self.controller = JobController(db, workers=workers,
+                                        dispatch=dispatch)
         self.stats = StatsProvider(db, capacity_bytes=capacity_bytes)
         self.bundles = SupportBundleManager(self.controller, self.stats)
         self.ingest = IngestManager(db)

@@ -9,16 +9,36 @@ controller.go:375-427), with progress scraped into status while RUNNING
 (cleanupNPRecommendation :390-403), and stale result rows reconciled
 against live CRs at startup (HandleStaleDbEntries util.go:239-270).
 
-Instead of submitting SparkApplications to an operator, the controller
-runs the analytics jobs on worker threads against the shared
-FlowDatabase — the TPU engine is in-process; scheduling is a thread
-pool, not a pod fleet.
+Two dispatch modes mirror the reference's two execution tiers:
+
+  dispatch="thread"      — jobs run on in-process worker threads
+                           against the shared FlowDatabase (the quick
+                           path; no isolation).
+  dispatch="subprocess"  — each job runs as a `python -m
+                           theia_tpu.runner` child process against a
+                           snapshot of the database, with progress
+                           scraped from --progress-file and result
+                           rows merged back on success. This is the
+                           reference's Spark driver/executor process
+                           boundary (pkg/controller/util.go:129-159,
+                           223-293): a crashing or OOMing kernel kills
+                           the RUNNER, not the manager — the record
+                           goes FAILED with the child's stderr tail.
+                           Device access is serialized across jobs
+                           (one child owns the accelerator at a time).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import datetime
+import json
+import os
 import queue
+import shutil
+import subprocess
+import sys
+import tempfile
 import threading
 import time
 import traceback
@@ -29,8 +49,9 @@ import numpy as np
 
 from ..analytics import (TadQuerySpec, run_drop_detection, run_npr,
                          run_tad)
+from ..runner.__main__ import TIME_FORMAT as RUNNER_TIME_FORMAT
 from ..runner.progress import (DD_STAGES, NPR_STAGES, TAD_STAGES,
-                               JobProgress)
+                               FileProgress, JobProgress)
 from ..store import FlowDatabase
 from ..utils import get_logger, parse_job_name, validate_policy_type
 
@@ -48,6 +69,12 @@ KIND_DD = "dd"
 
 _NAME_PREFIX = {KIND_NPR: "pr-", KIND_TAD: "tad-", KIND_DD: "dd-"}
 
+#: policy mode → job --option (reference recommend_policies_for_
+#: unprotected_flows, policy_recommendation_job.py:714); shared by
+#: both dispatch paths so they cannot diverge.
+POLICY_TYPE_OPTION = {"anp-deny-applied": 1, "anp-deny-all": 2,
+                      "k8s-np": 3}
+
 
 class DuplicateJobError(Exception):
     """A job with this name already exists (→ HTTP 409)."""
@@ -62,13 +89,14 @@ def job_id_from_name(kind: str, name: str) -> str:
 @dataclasses.dataclass
 class JobRecord:
     name: str
-    kind: str                      # KIND_NPR | KIND_TAD
+    kind: str                      # KIND_NPR | KIND_TAD | KIND_DD
     spec: Dict[str, object]
     state: str = STATE_NEW
     error_msg: str = ""
     start_time: float = 0.0
     end_time: float = 0.0
-    progress: Optional[JobProgress] = None
+    progress: Optional[object] = None   # JobProgress | FileProgress
+    runner_pid: int = 0                 # subprocess dispatch only
 
     @property
     def job_id(self) -> str:
@@ -94,8 +122,15 @@ class JobRecord:
 class JobController:
     """Reconciles job records into analytics runs over a worker pool."""
 
-    def __init__(self, db: FlowDatabase, workers: int = 2) -> None:
+    def __init__(self, db: FlowDatabase, workers: int = 2,
+                 dispatch: str = "thread") -> None:
+        if dispatch not in ("thread", "subprocess"):
+            raise ValueError(f"unknown dispatch mode {dispatch!r}")
         self.db = db
+        self.dispatch = dispatch
+        # One job owns the accelerator at a time in subprocess mode:
+        # two children would interleave compilations and thrash HBM.
+        self._device_lock = threading.Lock()
         self._records: Dict[str, JobRecord] = {}
         self._lock = threading.Lock()
         self._queue: "queue.Queue[str]" = queue.Queue()
@@ -221,63 +256,13 @@ class JobController:
     def _run(self, record: JobRecord) -> None:
         record.state = STATE_RUNNING
         record.start_time = time.time()
-        logger.v(1).info("job %s started", record.name)
+        logger.v(1).info("job %s started (%s)", record.name,
+                         self.dispatch)
         try:
-            if record.kind == KIND_TAD:
-                record.progress = JobProgress(record.job_id, TAD_STAGES)
-                spec = record.spec
-                run_tad(
-                    self.db, str(spec.get("jobType", "EWMA")),
-                    TadQuerySpec(
-                        start_time=spec.get("startInterval") or None,
-                        end_time=spec.get("endInterval") or None,
-                        ns_ignore_list=spec.get("nsIgnoreList") or (),
-                        agg_flow=str(spec.get("aggFlow", "") or ""),
-                        pod_label=str(spec.get("podLabel", "") or ""),
-                        pod_name=str(spec.get("podName", "") or ""),
-                        pod_namespace=str(
-                            spec.get("podNameSpace", "") or ""),
-                        external_ip=str(spec.get("externalIp", "") or ""),
-                        svc_port_name=str(
-                            spec.get("servicePortName", "") or ""),
-                        cluster_uuid=str(
-                            spec.get("clusterUUID", "") or ""),
-                        # 0 = auto cadence; absent = reference-exact.
-                        refit_every=int(spec["refitEvery"])
-                        if spec.get("refitEvery") is not None else 1),
-                    tad_id=record.job_id,
-                    progress=record.progress)
-            elif record.kind == KIND_DD:
-                record.progress = JobProgress(record.job_id, DD_STAGES)
-                spec = record.spec
-                run_drop_detection(
-                    self.db,
-                    job_type=str(spec.get("jobType", "initial")),
-                    detection_id=record.job_id,
-                    start_time=spec.get("startInterval") or None,
-                    end_time=spec.get("endInterval") or None,
-                    cluster_uuid=str(spec.get("clusterUUID", "") or ""),
-                    progress=record.progress)
+            if self.dispatch == "subprocess":
+                self._run_subprocess(record)
             else:
-                record.progress = JobProgress(record.job_id, NPR_STAGES)
-                spec = record.spec
-                policy_type = validate_policy_type(
-                    str(spec.get("policyType", "anp-deny-applied")))
-                option = {"anp-deny-applied": 1, "anp-deny-all": 2,
-                          "k8s-np": 3}[policy_type]
-                run_npr(
-                    self.db,
-                    recommendation_type=str(spec.get("jobType",
-                                                     "initial")),
-                    limit=int(spec.get("limit", 0) or 0),
-                    option=option,
-                    start_time=spec.get("startInterval") or None,
-                    end_time=spec.get("endInterval") or None,
-                    ns_allow_list=spec.get("nsAllowList") or None,
-                    rm_labels=bool(spec.get("excludeLabels", True)),
-                    to_services=bool(spec.get("toServices", True)),
-                    recommendation_id=record.job_id,
-                    progress=record.progress)
+                self._run_inprocess(record)
             record.state = STATE_COMPLETED
             logger.v(1).info("job %s completed in %.2fs", record.name,
                              time.time() - record.start_time)
@@ -293,10 +278,230 @@ class JobController:
             # If the CR was deleted while the job ran, its result rows
             # were written after delete()'s GC — clean them up now so
             # in-flight deletes keep the reference's cleanup semantics.
-            with self._lock:
-                deleted = record.name not in self._records
-            if deleted:
+            # (Identity check: a same-named recreation owns the name
+            # and its results now.)
+            if self._deleted(record):
                 self._delete_results(record.kind, record.job_id)
+
+    def _run_inprocess(self, record: JobRecord) -> None:
+        spec = record.spec
+        if record.kind == KIND_TAD:
+            record.progress = JobProgress(record.job_id, TAD_STAGES)
+            run_tad(
+                self.db, str(spec.get("jobType", "EWMA")),
+                TadQuerySpec(
+                    start_time=spec.get("startInterval") or None,
+                    end_time=spec.get("endInterval") or None,
+                    ns_ignore_list=spec.get("nsIgnoreList") or (),
+                    agg_flow=str(spec.get("aggFlow", "") or ""),
+                    pod_label=str(spec.get("podLabel", "") or ""),
+                    pod_name=str(spec.get("podName", "") or ""),
+                    pod_namespace=str(
+                        spec.get("podNameSpace", "") or ""),
+                    external_ip=str(spec.get("externalIp", "") or ""),
+                    svc_port_name=str(
+                        spec.get("servicePortName", "") or ""),
+                    cluster_uuid=str(
+                        spec.get("clusterUUID", "") or ""),
+                    # 0 = auto cadence; absent = reference-exact.
+                    refit_every=int(spec["refitEvery"])
+                    if spec.get("refitEvery") is not None else 1),
+                tad_id=record.job_id,
+                progress=record.progress)
+        elif record.kind == KIND_DD:
+            record.progress = JobProgress(record.job_id, DD_STAGES)
+            run_drop_detection(
+                self.db,
+                job_type=str(spec.get("jobType", "initial")),
+                detection_id=record.job_id,
+                start_time=spec.get("startInterval") or None,
+                end_time=spec.get("endInterval") or None,
+                cluster_uuid=str(spec.get("clusterUUID", "") or ""),
+                progress=record.progress)
+        else:
+            record.progress = JobProgress(record.job_id, NPR_STAGES)
+            policy_type = validate_policy_type(
+                str(spec.get("policyType", "anp-deny-applied")))
+            option = POLICY_TYPE_OPTION[policy_type]
+            run_npr(
+                self.db,
+                recommendation_type=str(spec.get("jobType",
+                                                 "initial")),
+                limit=int(spec.get("limit", 0) or 0),
+                option=option,
+                start_time=spec.get("startInterval") or None,
+                end_time=spec.get("endInterval") or None,
+                ns_allow_list=spec.get("nsAllowList") or None,
+                rm_labels=bool(spec.get("excludeLabels", True)),
+                to_services=bool(spec.get("toServices", True)),
+                recommendation_id=record.job_id,
+                progress=record.progress)
+
+    # -- subprocess dispatch ---------------------------------------------
+
+    def _fmt_time(self, value) -> str:
+        # RUNNER_TIME_FORMAT: the runner CLI's own constant, so the
+        # controller's formatting can't drift from its parsing.
+        return datetime.datetime.fromtimestamp(
+            int(value), tz=datetime.timezone.utc
+        ).strftime(RUNNER_TIME_FORMAT)
+
+    def _runner_args(self, record: JobRecord) -> List[str]:
+        """record.spec → the runner's Spark-job-shaped CLI argv
+        (reverse of the controllers' arg-build,
+        pkg/controller/anomalydetector/controller.go:525-620).
+        Validation errors raise here, before a process is spawned."""
+        spec = record.spec
+        args: List[str] = []
+        if record.kind == KIND_TAD:
+            args += ["tad", "--algo", str(spec.get("jobType", "EWMA"))]
+            if spec.get("nsIgnoreList"):
+                args += ["-n", json.dumps(spec["nsIgnoreList"])]
+            for flag, key in (
+                    ("--agg-flow", "aggFlow"),
+                    ("--pod-label", "podLabel"),
+                    ("--pod-name", "podName"),
+                    ("--pod-namespace", "podNameSpace"),
+                    ("--external-ip", "externalIp"),
+                    ("--svc-port-name", "servicePortName"),
+                    ("--cluster-uuid", "clusterUUID")):
+                if spec.get(key):
+                    args += [flag, str(spec[key])]
+            if spec.get("refitEvery") is not None:
+                args += ["--refit-every", str(int(spec["refitEvery"]))]
+        elif record.kind == KIND_DD:
+            args += ["dropdetection",
+                     "-t", str(spec.get("jobType", "initial"))]
+            if spec.get("clusterUUID"):
+                args += ["--cluster-uuid", str(spec["clusterUUID"])]
+        else:
+            policy_type = validate_policy_type(
+                str(spec.get("policyType", "anp-deny-applied")))
+            option = POLICY_TYPE_OPTION[policy_type]
+            args += ["npr",
+                     "-t", str(spec.get("jobType", "initial")),
+                     "-l", str(int(spec.get("limit", 0) or 0)),
+                     "-o", str(option),
+                     "--rm_labels",
+                     "true" if spec.get("excludeLabels", True)
+                     else "false",
+                     "--to_services",
+                     "true" if spec.get("toServices", True)
+                     else "false"]
+            if spec.get("nsAllowList"):
+                args += ["-n", json.dumps(spec["nsAllowList"])]
+        if spec.get("startInterval"):
+            args += ["-s", self._fmt_time(spec["startInterval"])]
+        if spec.get("endInterval"):
+            args += ["-e", self._fmt_time(spec["endInterval"])]
+        args += ["-i", record.job_id]
+        return args
+
+    def _runner_cmd(self, record: JobRecord, snap: str,
+                    progress_file: str) -> List[str]:
+        """Full child argv. Split out so tests can substitute a
+        controllable child process."""
+        return ([sys.executable, "-m", "theia_tpu.runner"]
+                + self._runner_args(record)
+                + ["--db", snap, "--progress-file", progress_file,
+                   "--out", snap + ".results.npz"])
+
+    def _deleted(self, record: JobRecord) -> bool:
+        """True when THIS record left the table — identity, not name:
+        a same-named recreation must not keep a doomed child alive
+        (or let a deleted one's results land)."""
+        with self._lock:
+            return self._records.get(record.name) is not record
+
+    def _run_subprocess(self, record: JobRecord) -> None:
+        """One job = one runner child over a database snapshot; the
+        process boundary is the failure domain (reference Spark
+        driver/executor isolation)."""
+        stages = {KIND_TAD: TAD_STAGES, KIND_DD: DD_STAGES,
+                  KIND_NPR: NPR_STAGES}[record.kind]
+        workdir = tempfile.mkdtemp(
+            prefix=f"theia-job-{record.job_id[:8]}-")
+        try:
+            snap = os.path.join(workdir, "db.npz")
+            progress_file = os.path.join(workdir, "progress.json")
+            # argv build doubles as spec validation — errors raise here,
+            # before the snapshot/spawn costs.
+            cmd = self._runner_cmd(record, snap, progress_file)
+            record.progress = FileProgress(record.job_id, stages,
+                                           progress_file)
+            pkg_root = os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))))
+            env = {**os.environ,
+                   "PYTHONPATH": pkg_root + os.pathsep +
+                   os.environ.get("PYTHONPATH", "")}
+            # Snapshot outside the device lock (table scans are
+            # thread-safe): only the child's device tenure serializes.
+            # Uncompressed — a short-lived handoff file, not a durable
+            # checkpoint.
+            self.db.save(snap, compress=False)
+            # Child output goes to files, not PIPEs: an undrained pipe
+            # fills at ~64 KiB and deadlocks a chatty child against
+            # our wait() loop.
+            err_path = os.path.join(workdir, "stderr.log")
+            with open(os.path.join(workdir, "stdout.log"), "wb") as out_f, \
+                    open(err_path, "wb") as err_f, \
+                    self._device_lock:
+                proc = subprocess.Popen(
+                    cmd, stdout=out_f, stderr=err_f, env=env,
+                    cwd=workdir)
+                record.runner_pid = proc.pid
+                try:
+                    while True:
+                        try:
+                            proc.wait(timeout=0.2)
+                            break
+                        except subprocess.TimeoutExpired:
+                            if self._deleted(record):  # delete cancels
+                                proc.kill()
+                except BaseException:
+                    proc.kill()
+                    proc.wait()
+                    raise
+            # final scrape before the scratch dir goes away
+            record.progress.snapshot()
+            if proc.returncode != 0:
+                with open(err_path, "rb") as f:
+                    err = f.read()[-8192:]
+                tail = " | ".join(err.decode(errors="replace")
+                                  .strip().splitlines()[-5:])
+                sig = (f"killed by signal {-proc.returncode}"
+                       if proc.returncode < 0
+                       else f"exited {proc.returncode}")
+                raise RuntimeError(
+                    f"runner {sig}" + (f": {tail}" if tail else ""))
+            self._merge_results(record, snap + ".results.npz")
+        finally:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+    def _merge_results(self, record: JobRecord, results: str) -> None:
+        """Copy the job's result rows from the runner's results-only
+        snapshot into the live database (dictionary re-encode happens
+        in Table insert adoption)."""
+        try:
+            out = FlowDatabase.load(results, build_views=False)
+        except FileNotFoundError:
+            # Contract violation (rc=0 but no results file) — only
+            # reachable with a substituted child; don't fail the job,
+            # just record it.
+            logger.error("job %s: runner wrote no results file %s",
+                         record.name, results)
+            return
+        src = {KIND_NPR: out.recommendations,
+               KIND_TAD: out.tadetector,
+               KIND_DD: out.dropdetection}[record.kind]
+        dst = {KIND_NPR: self.db.recommendations,
+               KIND_TAD: self.db.tadetector,
+               KIND_DD: self.db.dropdetection}[record.kind]
+        data = src.scan()
+        if len(data):
+            rows = data.filter(data.strings("id") == record.job_id)
+            if len(rows):
+                dst.insert(rows)
 
     def wait_all(self, timeout: float = 60.0) -> bool:
         """Test/CLI helper: block until the queue drains and no job is

@@ -14,8 +14,11 @@ reference scripts so the control plane stays drop-in compatible:
         --ns_allow_list, --id, --rm_labels, --to_services)
 
 Instead of a JDBC URL the runner takes --db (FlowDatabase .npz path);
-results are written back into the same database file. --progress-file
-emits Spark-UI-shaped progress (see progress.py).
+results are written back into the same database file, or — with --out —
+into a small results-only .npz (the manager's subprocess dispatch uses
+this so a job over a large snapshot doesn't rewrite the whole flows
+table just to hand back a few result rows). --progress-file emits
+Spark-UI-shaped progress (see progress.py).
 
 Usage:
   python -m theia_tpu.runner tad --db flows.npz --algo EWMA
@@ -40,6 +43,18 @@ def parse_time(value: Optional[str]) -> Optional[int]:
         return None
     dt = datetime.datetime.strptime(value, TIME_FORMAT)
     return int(dt.replace(tzinfo=datetime.timezone.utc).timestamp())
+
+
+RESULT_TABLES = ("tadetector", "recommendations", "dropdetection")
+
+
+def _save_results(db, args) -> None:
+    """--out: results-only snapshot (uncompressed: short-lived handoff
+    file); default: full database written back into --db."""
+    if getattr(args, "out", None):
+        db.save(args.out, tables=RESULT_TABLES, compress=False)
+    else:
+        db.save(args.db)
 
 
 def parse_json_list(value: Optional[str]) -> list:
@@ -87,6 +102,9 @@ def build_parser() -> argparse.ArgumentParser:
                      help="ARIMA refit cadence (1=exact per-step, "
                           "0=auto for long series)")
     tad.add_argument("--progress-file", default=None)
+    tad.add_argument("--out", default=None,
+                     help="write result tables only to this .npz "
+                          "(skips saving the full db back to --db)")
 
     npr = sub.add_parser("npr", help="network policy recommendation")
     npr.add_argument("--db", required=True)
@@ -102,6 +120,9 @@ def build_parser() -> argparse.ArgumentParser:
     npr.add_argument("--rm_labels", default="true")
     npr.add_argument("--to_services", default="true")
     npr.add_argument("--progress-file", default=None)
+    npr.add_argument("--out", default=None,
+                     help="write result tables only to this .npz "
+                          "(skips saving the full db back to --db)")
 
     dd = sub.add_parser("dropdetection",
                         help="abnormal traffic-drop detection "
@@ -115,6 +136,9 @@ def build_parser() -> argparse.ArgumentParser:
                     default="")
     dd.add_argument("-i", "--id", default=None)
     dd.add_argument("--progress-file", default=None)
+    dd.add_argument("--out", default=None,
+                    help="write result tables only to this .npz "
+                         "(skips saving the full db back to --db)")
     return p
 
 
@@ -146,7 +170,7 @@ def run_tad_job(args) -> str:
         db = FlowDatabase.load(args.db)
         job_id = run_tad(db, args.algo, spec, tad_id=args.id,
                          progress=progress)
-        db.save(args.db)
+        _save_results(db, args)
     except BaseException as e:
         progress.fail(str(e))
         raise
@@ -175,7 +199,7 @@ def run_npr_job(args) -> str:
             recommendation_id=args.id,
             progress=progress,
         )
-        db.save(args.db)
+        _save_results(db, args)
     except BaseException as e:
         progress.fail(str(e))
         raise
@@ -200,7 +224,7 @@ def run_dd_job(args) -> str:
             cluster_uuid=args.cluster_uuid,
             progress=progress,
         )
-        db.save(args.db)
+        _save_results(db, args)
     except BaseException as e:
         progress.fail(str(e))
         raise

@@ -88,6 +88,45 @@ class JobProgress:
             raise
 
 
+class FileProgress:
+    """Read side of a runner's --progress-file: the manager's
+    equivalent of the reference scraping the Spark UI REST into CRD
+    status (pkg/controller/util.go:129-159). snapshot() re-reads the
+    file and caches the last good document, so status stays correct
+    after the job's scratch directory is cleaned up."""
+
+    def __init__(self, job_id: str, stages: List[str],
+                 path: str) -> None:
+        self.job_id = job_id
+        self.stages = list(stages)
+        self.path = path
+        self._last = {
+            "id": job_id,
+            "state": "RUNNING",
+            "currentStage": "",
+            "completedStages": 0,
+            "totalStages": len(stages),
+            "errorMsg": "",
+            "startedAt": time.time(),
+        }
+
+    def snapshot(self) -> dict:
+        try:
+            with open(self.path) as f:
+                doc = json.load(f)
+            if isinstance(doc, dict) and "completedStages" in doc:
+                self._last = doc
+        except (OSError, ValueError):
+            pass   # mid-write/retired file: serve the cached snapshot
+        return dict(self._last)
+
+    def fail(self, error: str) -> None:
+        """The runner process owns the file; just reflect the failure
+        in the cached snapshot for status readers."""
+        self._last = {**self._last, "state": "FAILED",
+                      "errorMsg": error}
+
+
 TAD_STAGES = ["read", "tensorize", "score", "write"]
 NPR_STAGES = ["read", "recommend", "write"]
 DD_STAGES = ["read", "tensorize", "score", "write"]

@@ -326,13 +326,21 @@ class FlowDatabase:
 
     # -- persistence -------------------------------------------------------
 
-    def save(self, path: str) -> None:
-        """Persist all tables to one .npz (columns + dictionary tables),
-        stamped with the current schema version (store/migration.py)."""
+    def save(self, path: str, tables: Optional[Sequence[str]] = None,
+             compress: bool = True) -> None:
+        """Persist tables to one .npz (columns + dictionary tables),
+        stamped with the current schema version (store/migration.py).
+
+        `tables` restricts the snapshot (e.g. result tables only for a
+        job's write-back); `compress=False` trades disk for CPU —
+        right for short-lived job snapshots, wrong for durable
+        checkpoints."""
         from .migration import CURRENT_SCHEMA_VERSION, force
         payload: Dict[str, np.ndarray] = {}
         for table in (self.flows, self.tadetector, self.recommendations,
                       self.dropdetection):
+            if tables is not None and table.name not in tables:
+                continue
             data = table.scan()
             for col in table.schema:
                 payload[f"{table.name}/{col.name}"] = data[col.name]
@@ -340,7 +348,7 @@ class FlowDatabase:
                 payload[f"{table.name}/__dict__/{name}"] = np.asarray(
                     d._strings, dtype=object)
         force(payload, CURRENT_SCHEMA_VERSION)
-        np.savez_compressed(path, **payload)
+        (np.savez_compressed if compress else np.savez)(path, **payload)
 
     @classmethod
     def load(cls, path: str,

@@ -256,7 +256,8 @@ class ShardedFlowDatabase:
 
     # -- persistence ------------------------------------------------------
 
-    def save(self, path: str) -> None:
+    def save(self, path: str, tables=None, compress: bool = True
+             ) -> None:
         """Persist the *logical* contents as one single-node snapshot
         (FlowDatabase format); loading re-shards. Mirrors backing up a
         cluster through the Distributed table."""
@@ -270,7 +271,7 @@ class ShardedFlowDatabase:
             data = src.scan()
             if len(data):
                 dst.insert(data)
-        merged.save(path)
+        merged.save(path, tables=tables, compress=compress)
 
     @classmethod
     def load(cls, path: str, n_shards: int = 2,
