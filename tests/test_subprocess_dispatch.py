@@ -165,6 +165,24 @@ def test_delete_then_recreate_same_name_kills_old_child(db,
         ctl.shutdown()
 
 
+def test_shutdown_kills_running_child(db, monkeypatch):
+    """Controller shutdown must not orphan a runner child (it would
+    keep the accelerator claimed past the manager's death)."""
+    ctl = JobController(db, workers=1, dispatch="subprocess")
+    monkeypatch.setattr(
+        ctl, "_runner_cmd",
+        lambda record, snap, prog: [sys.executable, "-c",
+                                    "import time; time.sleep(120)"])
+    record = ctl.create(KIND_TAD, {"jobType": "EWMA"})
+    deadline = time.time() + 30
+    while record.runner_pid == 0 and time.time() < deadline:
+        time.sleep(0.05)
+    assert record.runner_pid > 0
+    ctl.shutdown()
+    with pytest.raises(OSError):
+        os.kill(record.runner_pid, 0)
+
+
 def test_device_serialization_one_child_at_a_time(db, monkeypatch,
                                                   tmp_path):
     """Two queued jobs with 2 workers must NOT run children

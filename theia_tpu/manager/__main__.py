@@ -7,9 +7,13 @@ Usage:
       [--tls-ca F]]] [--auth-token-file F | --auth-token T]
 
 --synth seeds the store with synthetic flows (demo/e2e); --db loads a
-persisted FlowDatabase (and persists results back on shutdown). TTL can
-also come from the THEIA_TTL_SECONDS env var (the deployment manifest
-sets it; flag wins).
+persisted FlowDatabase (and persists results back on shutdown). With
+--db, a background checkpointer also snapshots the store atomically
+every --checkpoint-interval seconds (default 60; 0 disables), bounding
+kill -9 data loss to one interval — the durability role the
+reference's ReplicatedMergeTree+ZooKeeper plays. TTL can also come
+from the THEIA_TTL_SECONDS env var (the deployment manifest sets it;
+flag wins).
 """
 
 from __future__ import annotations
@@ -36,6 +40,9 @@ def main(argv=None) -> None:
     p.add_argument("--capacity-bytes", type=int, default=8 << 30)
     p.add_argument("--ttl-seconds", type=int, default=None,
                    help="flow TTL; default THEIA_TTL_SECONDS env or off")
+    p.add_argument("--checkpoint-interval", type=float, default=60.0,
+                   help="seconds between background snapshots of --db "
+                        "(0 = only save on clean shutdown)")
     p.add_argument("--workers", type=int, default=2)
     p.add_argument("--dispatch", default="thread",
                    choices=["thread", "subprocess"],
@@ -64,6 +71,16 @@ def main(argv=None) -> None:
                         "random token is generated into the file if "
                         "absent (mode 0600)")
     args = p.parse_args(argv)
+
+    # Honor an explicit JAX_PLATFORMS before any backend initializes:
+    # deployment sitecustomize hooks may pin the platform
+    # programmatically, which silently overrides the env var — an
+    # operator pinning the manager to cpu would otherwise claim (and
+    # on kill, wedge) the accelerator tunnel. Same dance as bench.py.
+    plats = os.environ.get("JAX_PLATFORMS", "").strip()
+    if plats:
+        import jax
+        jax.config.update("jax_platforms", plats)
 
     from ..store import FlowDatabase, ShardedFlowDatabase
     from ..utils import get_logger, set_verbosity
@@ -144,6 +161,19 @@ def main(argv=None) -> None:
         threading.Thread(target=server.httpd.shutdown,
                          daemon=True).start()
 
+    checkpointer = None
+    if args.db and args.checkpoint_interval > 0:
+        from ..store import Checkpointer
+        # The store matches the on-disk file iff it was just loaded
+        # from it and not re-seeded — then the first tick can skip.
+        pristine = os.path.exists(args.db) and not args.synth
+        checkpointer = Checkpointer(db, args.db,
+                                    interval=args.checkpoint_interval,
+                                    assume_current=pristine)
+        checkpointer.start()
+        print(f"checkpointing {args.db} every "
+              f"{args.checkpoint_interval:g}s", file=sys.stderr)
+
     signal.signal(signal.SIGINT, stop)
     signal.signal(signal.SIGTERM, stop)
     server.serve_forever()
@@ -151,6 +181,8 @@ def main(argv=None) -> None:
     # it into the saved file.
     server.controller.wait_all(timeout=60)
     server.shutdown()
+    if checkpointer:
+        checkpointer.stop()
     if args.db:
         db.save(args.db)
 

@@ -458,6 +458,12 @@ class JobController:
                         except subprocess.TimeoutExpired:
                             if self._deleted(record):  # delete cancels
                                 proc.kill()
+                            elif self._stop.is_set():
+                                # controller shutdown must not orphan
+                                # a running child (it would keep the
+                                # accelerator claimed past the
+                                # manager's death)
+                                proc.kill()
                 except BaseException:
                     proc.kill()
                     proc.wait()
@@ -518,5 +524,12 @@ class JobController:
 
     def shutdown(self) -> None:
         self._stop.set()
+        # Generous join: a subprocess worker needs time to kill its
+        # child (stop flag is polled every 0.2s in the wait loop) and
+        # run its cleanup (workdir rmtree) — a 2s give-up would orphan
+        # both.
         for t in self._threads:
-            t.join(timeout=2)
+            t.join(timeout=15)
+        for t in self._threads:
+            if t.is_alive():
+                logger.error("job worker %s did not stop", t.name)

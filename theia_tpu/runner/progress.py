@@ -11,11 +11,11 @@ kept in memory for in-process callers.
 from __future__ import annotations
 
 import json
-import os
-import tempfile
 import threading
 import time
 from typing import List, Optional
+
+from ..utils import atomic_write
 
 
 class JobProgress:
@@ -74,18 +74,12 @@ class JobProgress:
         if not self.path:
             return
         snap = self.snapshot()
-        d = os.path.dirname(self.path) or "."
-        fd, tmp = tempfile.mkstemp(dir=d, prefix=".progress-")
-        try:
-            with os.fdopen(fd, "w") as f:
+
+        def write(tmp: str) -> None:
+            with open(tmp, "w") as f:
                 json.dump(snap, f)
-            os.replace(tmp, self.path)
-        except BaseException:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
+
+        atomic_write(self.path, write)
 
 
 class FileProgress:

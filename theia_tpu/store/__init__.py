@@ -1,5 +1,6 @@
 """Columnar flow store: tables, materialized views, TTL, retention."""
 
+from .checkpoint import Checkpointer
 from .flow_store import FlowDatabase, RetentionMonitor, Table
 from .sharded import (DistributedTable, DistributedView,
                       ShardedFlowDatabase)
@@ -7,7 +8,7 @@ from .views import (MATERIALIZED_VIEWS, ViewSpec, ViewTable, group_reduce,
                     group_sum)
 
 __all__ = [
-    "FlowDatabase", "RetentionMonitor", "Table",
+    "Checkpointer", "FlowDatabase", "RetentionMonitor", "Table",
     "DistributedTable", "DistributedView", "ShardedFlowDatabase",
     "MATERIALIZED_VIEWS", "ViewSpec", "ViewTable", "group_reduce", "group_sum",
 ]

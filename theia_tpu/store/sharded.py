@@ -64,6 +64,12 @@ class DistributedTable:
     def nbytes(self) -> int:
         return sum(t.nbytes for t in self.tables)
 
+    @property
+    def generation(self) -> int:
+        """Sum of shard mutation counters (monotonic: shard counters
+        only grow)."""
+        return sum(t.generation for t in self.tables)
+
     def _assign(self, n: int) -> np.ndarray:
         with self._lock:   # rand() routing; rng isn't thread-safe
             return self._rng.integers(0, len(self.tables), size=n)

@@ -3,6 +3,7 @@ input validation (job names, K8s quantities, algo enums), env config.
 Reference: pkg/util/ (utils.go, env/env.go) and klog usage throughout.
 """
 
+from .atomic import atomic_write  # noqa: F401
 from .env import (  # noqa: F401
     DEFAULT_NAMESPACE,
     env_float,
