@@ -240,15 +240,17 @@ def run_benchmarks() -> dict:
         print(f"store bench skipped: {e}", file=sys.stderr)
 
     # End-to-end pipeline: wire bytes → stream decode → store insert
-    # (3 MV fan-out, TTL check) → streaming detector → alert ring, the
-    # whole POST /ingest path as one number (VERDICT r2 #2). The
-    # detector leg runs on the HOST cpu backend here: under axon the
-    # host↔device link is a remote tunnel measured above at ~0.1 GB/s —
-    # a dev-environment artifact ~2 orders of magnitude below a real
-    # v5e host's DMA link — and letting the streaming state ride it
-    # would time the tunnel, not the pipeline.
+    # (3 MV fan-out, TTL check) → heavy-hitter + per-connection
+    # streaming detectors → alert ring — the whole POST /ingest path
+    # as one number (VERDICT r2 #2). The detector legs run on the HOST
+    # cpu backend here: under axon the host↔device link is a remote
+    # tunnel measured above at ~0.1 GB/s — a dev-environment artifact
+    # ~2 orders of magnitude below a real v5e host's DMA link — and
+    # letting streaming state ride it would time the tunnel, not the
+    # pipeline.
     e2e_rate = 0.0
     e2e_stages: dict = {}
+    e2e_scaling: dict = {}
     try:
         import contextlib
 
@@ -267,26 +269,46 @@ def run_benchmarks() -> dict:
             enc = BlockEncoder(dicts=big.dicts)
             blocks = [enc.encode(big) for _ in range(9)]
             with cpu_ctx:
+                # Headline: the real IngestManager path, one stream.
+                # Best-of-2 passes: shared-host CPU steal makes single
+                # passes noisy (observed 2-3x swings on idle RAM).
                 im = IngestManager(FlowDatabase(ttl_seconds=12 * 3600))
                 im.ingest(blocks[0])   # warm: dict deltas + jit
-                t9 = time.perf_counter()
-                n_e2e = sum(im.ingest(p)["rows"] for p in blocks[1:])
-                dt = time.perf_counter() - t9
-            # Stage breakdown on the same payloads (fresh state each);
-            # warm the store with a separate decode of blocks[0] so
-            # t_store covers the same 8 blocks dt does.
-            d2 = TsvDecoder()
-            warm = d2.decode_block(blocks[0])
-            ta = time.perf_counter()
-            decoded = [d2.decode_block(p) for p in blocks[1:]]
-            t_dec = time.perf_counter() - ta
-            db2 = FlowDatabase(ttl_seconds=12 * 3600)
-            db2.insert_flows(warm)
-            ta = time.perf_counter()
-            for b in decoded:
-                db2.insert_flows(b)
-            t_store = time.perf_counter() - ta
-            t_det = max(dt - t_dec - t_store, 1e-9)
+                dt = float("inf")
+                for _ in range(2):
+                    t9 = time.perf_counter()
+                    n_e2e = sum(im.ingest(p)["rows"]
+                                for p in blocks[1:])
+                    dt = min(dt, time.perf_counter() - t9)
+
+                # Stage attribution: replicate the same pipeline with
+                # per-stage stopwatches IN ONE LOOP (separate passes
+                # skew — adoption/dict caches warm differently and the
+                # remainder can go negative).
+                from theia_tpu.analytics.heavy_hitters import \
+                    HeavyHitterDetector
+                from theia_tpu.analytics.streaming import \
+                    StreamingDetector
+                d2 = TsvDecoder()
+                db2 = FlowDatabase(ttl_seconds=12 * 3600)
+                hh2, sd2 = HeavyHitterDetector(), StreamingDetector()
+                warm = d2.decode_block(blocks[0])
+                db2.insert_flows(warm)
+                hh2.update(warm)
+                sd2.ingest(warm)
+                t_dec = t_store = t_det = 0.0
+                for p in blocks[1:]:
+                    ta = time.perf_counter()
+                    b = d2.decode_block(p)
+                    tb = time.perf_counter()
+                    db2.insert_flows(b)
+                    tc = time.perf_counter()
+                    hh2.update(b)
+                    sd2.ingest(b)
+                    td = time.perf_counter()
+                    t_dec += tb - ta
+                    t_store += tc - tb
+                    t_det += td - tc
             e2e_rate = n_e2e / dt
             e2e_stages = {
                 "decode_rows_per_sec": round(n_e2e / t_dec),
@@ -294,13 +316,64 @@ def run_benchmarks() -> dict:
                 "detector_rows_per_sec": round(n_e2e / t_det),
             }
             cap = min(e2e_stages, key=e2e_stages.get)
-            print(f"end-to-end ingest (wire->store+views->detector"
+            cores = os.cpu_count() or 1
+            print(f"end-to-end ingest (wire->store+views->2 detectors"
                   f"->alerts): {e2e_rate:,.0f} rows/s "
                   f"[decode {n_e2e / t_dec:,.0f}, store "
                   f"{n_e2e / t_store:,.0f}, "
-                  f"detector+rest {n_e2e / t_det:,.0f} rows/s; "
-                  f"cap: {cap}; host cores={os.cpu_count()}; "
-                  f"single stream, single thread]", file=sys.stderr)
+                  f"detectors {n_e2e / t_det:,.0f} rows/s; "
+                  f"cap: {cap}; host cores={cores}; "
+                  f"{e2e_rate / cores:,.0f} rows/s/core, single "
+                  f"stream]", file=sys.stderr)
+
+            # Multi-stream scaling structure: k producer threads, one
+            # IngestManager, distinct streams (decode parallelizes —
+            # the native decoder and group-sum release the GIL; the
+            # detector leg serializes on its lock). On a 1-core host
+            # expect ~flat; the structure is what a multi-core v5e
+            # host scales.
+            import gc
+            import threading
+
+            # Drop the headline/attribution stores first: three live
+            # ~200 MB databases push a small bench VM into swap and
+            # the scaling numbers stop measuring the pipeline.
+            del im, db2, hh2, sd2, warm
+            gc.collect()
+            with cpu_ctx:
+                for k in (1, 2, 4):
+                    imk = IngestManager(
+                        FlowDatabase(ttl_seconds=12 * 3600))
+                    encs = [BlockEncoder(dicts=big.dicts)
+                            for _ in range(k)]
+                    payloads = [[e.encode(big) for _ in range(4)]
+                                for e in encs]
+                    # warm each stream's dict chain + jit
+                    for i in range(k):
+                        imk.ingest(payloads[i][0], stream=f"s{i}")
+
+                    def feed(i):
+                        for p in payloads[i][1:]:
+                            imk.ingest(p, stream=f"s{i}")
+
+                    best = float("inf")
+                    for _ in range(2):   # best-of-2 vs CPU steal
+                        threads = [threading.Thread(target=feed,
+                                                    args=(i,))
+                                   for i in range(k)]
+                        ts = time.perf_counter()
+                        for t in threads:
+                            t.start()
+                        for t in threads:
+                            t.join()
+                        best = min(best, time.perf_counter() - ts)
+                    rows = k * 3 * len(big)
+                    e2e_scaling[str(k)] = round(rows / best)
+                    del imk, payloads
+                    gc.collect()
+                print("multi-stream e2e: " + ", ".join(
+                    f"{k} streams {v:,} rows/s"
+                    for k, v in e2e_scaling.items()), file=sys.stderr)
     except Exception as e:
         print(f"e2e bench skipped: {e}", file=sys.stderr)
 
@@ -332,6 +405,10 @@ def run_benchmarks() -> dict:
     }
     if e2e_stages:
         result["e2e_stages"] = e2e_stages
+    if e2e_scaling:
+        result["e2e_multi_stream_rows_per_sec"] = e2e_scaling
+        result["e2e_rows_per_sec_per_core"] = round(
+            e2e_rate / (os.cpu_count() or 1))
     if dev.platform == "cpu":
         result["degraded"] = "cpu fallback (accelerator unavailable)"
     return result

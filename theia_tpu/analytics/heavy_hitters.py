@@ -24,8 +24,9 @@ no string work on the hot path. Alerts carry decoded names.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -41,6 +42,23 @@ from ..ops.sketch import (
 from ..schema import ColumnarBatch
 
 FEATURES = 4
+
+
+@jax.jit
+def _fused_step(cms: CmsState, km: KMeansState, keys: jnp.ndarray,
+                volumes: jnp.ndarray, q: jnp.ndarray,
+                feats: jnp.ndarray, valid: jnp.ndarray
+                ) -> Tuple[CmsState, KMeansState, jnp.ndarray,
+                           jnp.ndarray]:
+    """The whole per-batch device step as ONE dispatch: sketch update,
+    heavy-hitter query, k-means step. Per-dispatch overhead (host→
+    device puts + sync round trips) dominates the actual compute on
+    weak ingest hosts, so three separate kernel calls per block would
+    triple the fixed cost."""
+    cms = cms_update(cms, keys, volumes)
+    est = cms_query(cms, q)
+    km, _, dist = kmeans_step(km, feats, valid)
+    return cms, km, est, dist
 
 
 @dataclasses.dataclass
@@ -114,20 +132,33 @@ class HeavyHitterDetector:
         keys[:n] = dst_codes.astype(np.uint32)
         vols = np.zeros(size, np.float32)
         vols[:n] = np.asarray(batch["octetDeltaCount"], np.float32)
-        self.cms = cms_update(self.cms, jnp.asarray(keys),
-                              jnp.asarray(vols))
+
+        # Heavy-hitter query keys: this batch's distinct destinations.
+        uniq_codes = np.unique(dst_codes)
+        q = np.zeros(self._pad(len(uniq_codes)), np.uint32)
+        q[:len(uniq_codes)] = uniq_codes.astype(np.uint32)
+
+        # Traffic-shape features (padded rows are masked out of the
+        # centroid update).
+        feats = np.zeros((size, FEATURES), np.float32)
+        feats[:n] = self._features(batch)
+        valid = np.zeros(size, bool)
+        valid[:n] = True
+
+        # One dispatch, one fetch. Host arrays go in raw: jit batches
+        # the transfers into the call instead of one device_put round
+        # trip per array.
+        self.cms, self.kmeans, est_d, dist_d = _fused_step(
+            self.cms, self.kmeans, keys, vols, q, feats, valid)
+        est, total, dist = jax.device_get(
+            (est_d, self.cms.total, dist_d))
+        est = est[:len(uniq_codes)]
+        total = float(total)
+        dist = dist[:n]
         self.batches += 1
 
         alerts: List[HeavyHitterAlert] = []
         dst_dict = batch.dicts.get("destinationIP")
-
-        # Heavy hitters among this batch's distinct destinations.
-        uniq_codes = np.unique(dst_codes)
-        q = np.zeros(self._pad(len(uniq_codes)), np.uint32)
-        q[:len(uniq_codes)] = uniq_codes.astype(np.uint32)
-        est = np.asarray(cms_query(
-            self.cms, jnp.asarray(q)))[:len(uniq_codes)]
-        total = float(self.cms.total)
         if total > 0:
             share = est / total
             for code, e, s in zip(uniq_codes, est, share):
@@ -136,16 +167,6 @@ class HeavyHitterDetector:
                             if dst_dict else str(int(code)))
                     alerts.append(HeavyHitterAlert(
                         "heavy_hitter", name, float(e), float(s)))
-
-        # Traffic-shape outliers via online k-means (padded rows are
-        # masked out of the centroid update).
-        feats = np.zeros((size, FEATURES), np.float32)
-        feats[:n] = self._features(batch)
-        valid = np.zeros(size, bool)
-        valid[:n] = True
-        self.kmeans, assign, dist = kmeans_step(
-            self.kmeans, jnp.asarray(feats), jnp.asarray(valid))
-        dist = np.asarray(dist)[:n]
         scale = float(np.mean(dist)) if len(dist) else 0.0
         # Warmup: let centroids settle before alerting on distance.
         if self.batches > 3 and self._dist_scale > 0:

@@ -249,10 +249,13 @@ class StreamingDetector:
 
     def describe_alert(self, batch: ColumnarBatch,
                        alert: Dict[str, object]) -> Dict[str, object]:
-        """Decode an alert's connection identity from its source row."""
-        i = alert["row"]
+        """Decode an alert's connection identity from its source row.
+        Per-cell decode_one, NOT a whole-column decode — an alert
+        burst would otherwise pay O(rows) string work per alert."""
+        i = int(alert["row"])
         out = dict(alert)
         for c in CONNECTION_KEY_COLUMNS:
-            out[c] = (batch.strings(c)[i] if c in batch.dicts
+            d = batch.dicts.get(c)
+            out[c] = (d.decode_one(int(batch[c][i])) if d is not None
                       else int(batch[c][i]))
         return out

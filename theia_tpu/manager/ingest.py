@@ -40,7 +40,7 @@ import numpy as np
 from ..analytics.heavy_hitters import HeavyHitterDetector
 from ..analytics.streaming import StreamingDetector
 from ..ingest.native import BLOCK_MAGIC, BLOCK_MAGIC_V1, TsvDecoder
-from ..schema import ColumnarBatch, StringDictionary
+from ..schema import ColumnarBatch, DictionaryMapper, StringDictionary
 from ..utils import get_logger
 
 logger = get_logger("ingest")
@@ -110,15 +110,16 @@ class IngestManager:
         # Detector keys must be stable across streams and stream
         # resets; stream-local dictionary codes are neither, so the
         # key columns re-encode against these ingest-global
-        # dictionaries before scoring. The re-encode is an int32 code
-        # remap through a cached per-source-dictionary mapping
-        # (extended only for newly minted entries) — no string objects
-        # on the hot path.
+        # dictionaries before scoring (cached incremental mappings,
+        # schema.DictionaryMapper — no string objects on the hot
+        # path). Sized to survive reset churn across MAX_STREAMS
+        # producers; serialized by the detector lock.
         self._global_dicts: Dict[str, StringDictionary] = {
             c: StringDictionary() for c in self.GLOBAL_COLUMNS}
-        # column → {id(src dict) → (src ref, int32 map)}
-        self._code_maps: Dict[str, Dict[int, tuple]] = {
-            c: {} for c in self.GLOBAL_COLUMNS}
+        self._mappers: Dict[str, DictionaryMapper] = {
+            c: DictionaryMapper(self._global_dicts[c],
+                                max_entries=2 * MAX_STREAMS)
+            for c in self.GLOBAL_COLUMNS}
 
     def _stream(self, stream_id: str) -> _Stream:
         with self._registry_lock:
@@ -197,15 +198,19 @@ class IngestManager:
                  **{c: self._global_dicts[c]
                     for c in self.GLOBAL_COLUMNS}})
             alerts = self.detector.update(scored)
+            raw_conn = self.streaming.ingest(scored)
+            # The ring keeps MAX_ALERTS; in an alert storm only the
+            # newest survive, so only those are worth decoding.
+            n_conn = len(raw_conn)
             conn_alerts = []
-            for a in self.streaming.ingest(scored):
+            for a in raw_conn[-MAX_ALERTS:]:
                 described = self.streaming.describe_alert(scored, a)
                 # "row" is batch-local; meaningless once published
                 described.pop("row", None)
                 described["kind"] = "connection_anomaly"
                 conn_alerts.append(described)
         now = time.time()
-        n_alerts = len(alerts) + len(conn_alerts)
+        n_alerts = len(alerts) + n_conn
         with self._alerts_lock:
             for a in alerts:
                 self._alerts.appendleft(
@@ -219,37 +224,10 @@ class IngestManager:
 
     def _global_codes(self, column: str,
                       batch: ColumnarBatch) -> np.ndarray:
-        """Map the batch's stream-local codes for `column` onto the
-        ingest-global dictionary via a cached int32 mapping (amortized
-        O(new dictionary entries), not O(rows) string work). Caller
-        holds the detector lock. Keeps a strong reference to each
-        source dictionary so an id() can never be reused while its
-        mapping is cached (streams are bounded by MAX_STREAMS)."""
-        src = batch.dicts[column]
-        maps = self._code_maps[column]
-        gdict = self._global_dicts[column]
-        entry = maps.pop(id(src), None)
-        if entry is None or entry[0] is not src:
-            if len(maps) >= 2 * MAX_STREAMS:
-                # Stream resets mint new dictionaries; drop the
-                # least-recently-used mappings so reset churn can't
-                # grow this unboundedly. Every lookup re-inserts its
-                # key (pop above + insert below), so insertion order
-                # IS recency order and the front of the dict holds the
-                # coldest entries — reset-orphaned dictionaries age to
-                # the front, active streams stay at the back.
-                for stale in list(maps)[:MAX_STREAMS]:
-                    del maps[stale]
-            entry = (src, np.zeros(0, np.int32))
-        src_ref, mapping = entry
-        if len(mapping) < len(src):
-            new = np.fromiter(
-                (gdict.encode_one(s)
-                 for s in src.entries_since(len(mapping))),
-                dtype=np.int32)
-            mapping = np.concatenate([mapping, new])
-        maps[id(src)] = (src_ref, mapping)
-        return mapping[np.asarray(batch[column], np.int64)]
+        """Stream-local → ingest-global codes for `column` (caller
+        holds the detector lock)."""
+        return self._mappers[column].remap(batch[column],
+                                           batch.dicts[column])
 
     def recent_alerts(self, limit: int = 100) -> List[Dict[str, object]]:
         with self._alerts_lock:

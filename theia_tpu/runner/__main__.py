@@ -232,6 +232,15 @@ def run_dd_job(args) -> str:
 
 
 def main(argv=None) -> None:
+    # Honor an explicit JAX_PLATFORMS before any backend initializes
+    # (deployment sitecustomize hooks may pin the platform
+    # programmatically, overriding the env var) — the manager spawns
+    # runner children with the platform it wants them on.
+    import os
+    plats = os.environ.get("JAX_PLATFORMS", "").strip()
+    if plats:
+        import jax
+        jax.config.update("jax_platforms", plats)
     args = build_parser().parse_args(argv)
     if args.job == "tad":
         job_id = run_tad_job(args)

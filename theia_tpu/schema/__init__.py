@@ -9,4 +9,8 @@ from .flow_schema import (  # noqa: F401
     RECOMMENDATIONS_SCHEMA,
     DROPDETECTION_SCHEMA,
 )
-from .columnar import StringDictionary, ColumnarBatch  # noqa: F401
+from .columnar import (  # noqa: F401
+    ColumnarBatch,
+    DictionaryMapper,
+    StringDictionary,
+)

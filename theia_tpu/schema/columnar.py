@@ -91,6 +91,54 @@ class StringDictionary:
         return out
 
 
+class DictionaryMapper:
+    """Cached int32 code remap from source dictionaries onto one
+    destination dictionary.
+
+    The hot-path alternative to re-encoding strings row-by-row: per
+    source dictionary, keep an int32 array mapping its codes to the
+    destination's, extended only for entries minted since the last
+    call — amortized O(new dictionary entries), zero string work for
+    a steady population. Entries hold a strong reference to their
+    source dictionary so an id() can never be recycled while its
+    mapping is cached; a bounded LRU evicts mappings orphaned by
+    producer resets. NOT thread-safe: callers serialize (the ingest
+    detector lock, the table adoption lock).
+    """
+
+    def __init__(self, dst: StringDictionary,
+                 max_entries: int = 128) -> None:
+        self.dst = dst
+        self.max_entries = max_entries
+        self._maps: Dict[int, tuple] = {}   # id(src) → (src, mapping)
+
+    def mapping(self, src: StringDictionary) -> np.ndarray:
+        entry = self._maps.pop(id(src), None)
+        if entry is None or entry[0] is not src:
+            if len(self._maps) >= self.max_entries:
+                # Every lookup re-inserts its key (pop above + insert
+                # below), so insertion order IS recency order: the
+                # front of the dict holds the coldest entries.
+                for stale in list(self._maps)[:self.max_entries // 2]:
+                    del self._maps[stale]
+            entry = (src, np.zeros(0, np.int32))
+        src_ref, mapping = entry
+        if len(mapping) < len(src):
+            new = np.fromiter(
+                (self.dst.encode_one(s)
+                 for s in src.entries_since(len(mapping))),
+                dtype=np.int32)
+            mapping = np.concatenate([mapping, new])
+        self._maps[id(src)] = (src_ref, mapping)
+        return mapping
+
+    def remap(self, codes: np.ndarray,
+              src: StringDictionary) -> np.ndarray:
+        if src is self.dst:
+            return np.asarray(codes, np.int32)
+        return self.mapping(src)[np.asarray(codes, np.int64)]
+
+
 class ColumnarBatch:
     """Equal-length struct-of-arrays with an associated dictionary set.
 
