@@ -189,6 +189,69 @@ def mine_frequent_patterns(
     return out
 
 
+def run_pattern_mining(db,
+                       min_support: int = 0,
+                       columns: Sequence[str] = DEFAULT_COLUMNS,
+                       max_len: int = 3,
+                       start_time: Optional[int] = None,
+                       end_time: Optional[int] = None,
+                       mining_id: Optional[str] = None,
+                       mesh="auto",
+                       now: Optional[int] = None,
+                       progress=None) -> str:
+    """Execute a pattern-mining job over the flow store; writes one
+    row per frequent itemset to the `flowpatterns` table and returns
+    the mining id.
+
+    The user-facing form of the north-star FP-Growth config — a job
+    kind beside TAD/NPR (the reference has no itemset mining at all).
+    min_support=0 auto-scales to 1% of the window's rows (floor 2).
+    mesh="auto" shards transactions over every visible device with
+    psum-allreduced support counts (parallel.job_mesh).
+    """
+    import time as _time
+    import uuid as _uuid
+
+    mining_id = mining_id or str(_uuid.uuid4())
+    if mesh == "auto":
+        from ..parallel import job_mesh
+        mesh = job_mesh()
+
+    if progress:
+        progress.stage("read")
+    flows = db.flows.select(start_time, end_time)
+    if len(flows) == 0:
+        if progress:
+            progress.done()
+        return mining_id
+    support = int(min_support) if min_support else max(
+        2, len(flows) // 100)
+
+    if progress:
+        progress.stage("mine")
+    patterns = mine_frequent_patterns(
+        flows, support, columns=columns, max_len=max_len, mesh=mesh)
+
+    if progress:
+        progress.stage("write")
+    created = int(now if now is not None else _time.time())
+    rows = [{
+        "id": mining_id,
+        "timeCreated": created,
+        # column=value pairs |-joined: the same delimiter convention
+        # the NPR peer strings use (reference
+        # policy_recommendation_job.py peer-string protocol)
+        "items": "|".join(f"{col}={val}" for col, val in itemset),
+        "itemsetLength": len(itemset),
+        "support": support_count,
+    } for itemset, support_count in patterns]
+    if rows:
+        db.flowpatterns.insert_rows(rows)
+    if progress:
+        progress.done()
+    return mining_id
+
+
 def _counts_over(rows: np.ndarray, mesh: Optional[jax.sharding.Mesh],
                  fn, extra: Optional[jnp.ndarray] = None) -> np.ndarray:
     """Run a support-count kernel over all rows: single device, or

@@ -17,7 +17,7 @@ variant its benchmark config names.
 
 from __future__ import annotations
 
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 import jax.numpy as jnp
 import numpy as np
@@ -65,16 +65,41 @@ def flow_embeddings(flows: ColumnarBatch) -> np.ndarray:
 def spatial_outliers(flows: ColumnarBatch,
                      eps: float = DEFAULT_EPS,
                      min_samples: int = DEFAULT_MIN_SAMPLES,
-                     block: int = 1024) -> List[Dict[str, object]]:
+                     block: int = 1024,
+                     mesh=None,
+                     embeddings: Optional[np.ndarray] = None
+                     ) -> List[Dict[str, object]]:
     """Flows outside every recurring traffic pattern. Returns one dict
-    per noise flow: decoded source/destination/port/bytes."""
+    per noise flow: decoded source/destination/port/bytes. With
+    `mesh`, the pairwise pass shards rows over the mesh with
+    all_gathered points/core-flags (parallel.make_sharded_points_
+    dbscan). `embeddings` lets a caller that already embedded the
+    flows (run_spatial's staged progress) skip recomputation."""
     n = len(flows)
     if n == 0:
         return []
-    emb = flow_embeddings(flows)
-    noise = np.asarray(dbscan_points_noise(
-        jnp.asarray(emb), jnp.ones(n, bool), eps=eps,
-        min_samples=min_samples, block=block))
+    emb = embeddings if embeddings is not None \
+        else flow_embeddings(flows)
+    if mesh is not None:
+        from ..parallel import make_sharded_points_dbscan, \
+            pad_to_multiple
+        from ..parallel.mesh import ROWS_AXIS, make_rows_mesh
+        if ROWS_AXIS not in mesh.axis_names:
+            # job_mesh() hands out the (series x time) job mesh; the
+            # points kernel shards tile ROWS — rebuild over the same
+            # devices with the rows axis.
+            mesh = make_rows_mesh(devices=mesh.devices.flatten())
+        n_dev = mesh.devices.size
+        padded, _ = pad_to_multiple(emb, n_dev, axis=0)
+        valid = np.zeros(len(padded), bool)
+        valid[:n] = True
+        noise = np.asarray(make_sharded_points_dbscan(
+            mesh, eps=eps, min_samples=min_samples)(
+            jnp.asarray(padded), jnp.asarray(valid)))[:n]
+    else:
+        noise = np.asarray(dbscan_points_noise(
+            jnp.asarray(emb), jnp.ones(n, bool), eps=eps,
+            min_samples=min_samples, block=block))
     idx = np.nonzero(noise)[0]
     src = flows.strings("sourceIP")
     dst = flows.strings("destinationIP")
@@ -83,3 +108,60 @@ def spatial_outliers(flows: ColumnarBatch,
     return [{"sourceIP": str(src[i]), "destinationIP": str(dst[i]),
              "destinationTransportPort": int(port[i]),
              "octetDeltaCount": int(octets[i])} for i in idx]
+
+
+def run_spatial(db,
+                eps: float = DEFAULT_EPS,
+                min_samples: int = DEFAULT_MIN_SAMPLES,
+                start_time=None,
+                end_time=None,
+                spatial_id=None,
+                mesh="auto",
+                now=None,
+                progress=None) -> str:
+    """Execute a spatial anomaly-detection job over the flow store;
+    writes one row per noise flow to the `spatialnoise` table and
+    returns the detection id.
+
+    The user-facing form of the north-star spatial-DBSCAN config — a
+    job kind beside TAD/NPR (the reference's DBSCAN is per-connection
+    1-D throughput only, plugins/anomaly-detection/
+    anomaly_detection.py:325-349). mesh="auto" shards the pairwise
+    pass over every visible device (parallel.job_mesh).
+    """
+    import time as _time
+    import uuid as _uuid
+
+    spatial_id = spatial_id or str(_uuid.uuid4())
+    if mesh == "auto":
+        from ..parallel import job_mesh
+        mesh = job_mesh()
+
+    if progress:
+        progress.stage("read")
+    flows = db.flows.select(start_time, end_time)
+    if len(flows) == 0:
+        if progress:
+            progress.done()
+        return spatial_id
+
+    if progress:
+        progress.stage("embed")
+    emb = flow_embeddings(flows)
+
+    if progress:
+        progress.stage("score")
+    outliers = spatial_outliers(flows, eps=eps,
+                                min_samples=min_samples, mesh=mesh,
+                                embeddings=emb)
+
+    if progress:
+        progress.stage("write")
+    created = int(now if now is not None else _time.time())
+    rows = [{**o, "id": spatial_id, "timeCreated": created}
+            for o in outliers]
+    if rows:
+        db.spatialnoise.insert_rows(rows)
+    if progress:
+        progress.done()
+    return spatial_id

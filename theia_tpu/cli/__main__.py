@@ -47,6 +47,8 @@ POLL_TIMEOUT = 3600.0
 NPR_RESOURCE = "networkpolicyrecommendations"
 TAD_RESOURCE = "throughputanomalydetectors"
 DD_RESOURCE = "trafficdropdetections"
+FPM_RESOURCE = "flowpatternminings"
+SAD_RESOURCE = "spatialanomalydetections"
 
 TIME_FORMAT = "%Y-%m-%d %H:%M:%S"
 
@@ -375,6 +377,115 @@ def dd_delete(args) -> None:
           f"name: {args.name}")
 
 
+# -- pattern mining (north-star FP-Growth config; no reference CLI) -----
+
+def _print_fpm_stats(stats) -> None:
+    if not stats:
+        print("No frequent patterns found")
+        return
+    _print_table(stats, ["id", "items", "itemsetLength", "support"])
+
+
+def fpm_run(args) -> None:
+    name = "fpm-" + str(uuid.uuid4())
+    body = {
+        "metadata": {"name": name},
+        "minSupport": args.min_support or None,
+        "maxLen": args.max_len,
+        "columns": [c.strip() for c in args.columns.split(",")
+                    if c.strip()] or None,
+        "startInterval": _parse_time_arg(args.start_time, "start-time"),
+        "endInterval": _parse_time_arg(args.end_time, "end-time"),
+    }
+    body = {k: v for k, v in body.items() if v is not None}
+    _request(args.manager_addr, "POST", f"{GROUP}/{FPM_RESOURCE}", body)
+    print(f"Successfully started flow pattern mining job with "
+          f"name: {name}")
+    if args.wait:
+        doc = _wait_for_job(args.manager_addr, FPM_RESOURCE, name)
+        st = doc.get("status") or {}
+        if st.get("state") == "FAILED":
+            raise APIError(
+                f"error: job failed: {st.get('errorMsg', '')}")
+        _print_fpm_stats(doc.get("stats", []))
+
+
+def _simple_actions(resource, label, print_stats):
+    """status/retrieve/list/delete handlers for a job resource."""
+
+    def status(args):
+        doc = _request(args.manager_addr, "GET",
+                       f"{GROUP}/{resource}/{args.name}")
+        st = doc.get("status") or {}
+        print(f"Status of this {label} job is {st.get('state', '')}")
+        if st.get("state") == "RUNNING":
+            print(f"Completed stages: {st.get('completedStages', 0)}/"
+                  f"{st.get('totalStages', 0)}")
+
+    def retrieve(args):
+        doc = _request(args.manager_addr, "GET",
+                       f"{GROUP}/{resource}/{args.name}")
+        stats = doc.get("stats", [])
+        if args.file:
+            with open(args.file, "w") as f:
+                json.dump(stats, f, indent=2)
+            print(f"Results written to {args.file}")
+        else:
+            print_stats(stats)
+
+    def list_(args):
+        doc = _request(args.manager_addr, "GET", f"{GROUP}/{resource}")
+        _print_job_table(doc.get("items", []))
+
+    def delete(args):
+        _request(args.manager_addr, "DELETE",
+                 f"{GROUP}/{resource}/{args.name}")
+        print(f"Successfully deleted {label} job with name: "
+              f"{args.name}")
+
+    return status, retrieve, list_, delete
+
+
+fpm_status, fpm_retrieve, fpm_list, fpm_delete = _simple_actions(
+    FPM_RESOURCE, "flow pattern mining", _print_fpm_stats)
+
+
+# -- spatial anomaly detection (north-star spatial-DBSCAN config) -------
+
+def _print_sad_stats(stats) -> None:
+    if not stats:
+        print("No spatial anomalies found")
+        return
+    _print_table(stats, ["id", "sourceIP", "destinationIP",
+                         "destinationTransportPort", "octetDeltaCount"])
+
+
+def sad_run(args) -> None:
+    name = "sad-" + str(uuid.uuid4())
+    body = {
+        "metadata": {"name": name},
+        "eps": args.eps,
+        "minSamples": args.min_samples,
+        "startInterval": _parse_time_arg(args.start_time, "start-time"),
+        "endInterval": _parse_time_arg(args.end_time, "end-time"),
+    }
+    body = {k: v for k, v in body.items() if v is not None}
+    _request(args.manager_addr, "POST", f"{GROUP}/{SAD_RESOURCE}", body)
+    print(f"Successfully started spatial anomaly detection job with "
+          f"name: {name}")
+    if args.wait:
+        doc = _wait_for_job(args.manager_addr, SAD_RESOURCE, name)
+        st = doc.get("status") or {}
+        if st.get("state") == "FAILED":
+            raise APIError(
+                f"error: job failed: {st.get('errorMsg', '')}")
+        _print_sad_stats(doc.get("stats", []))
+
+
+sad_status, sad_retrieve, sad_list, sad_delete = _simple_actions(
+    SAD_RESOURCE, "spatial anomaly detection", _print_sad_stats)
+
+
 # -- clickhouse / supportbundle / version -------------------------------
 
 def clickhouse_status(args) -> None:
@@ -568,6 +679,41 @@ def build_parser() -> argparse.ArgumentParser:
 
     add_job_commands(dd, dd_run, dd_status, dd_retrieve, dd_list,
                      dd_delete, dd_flags)
+
+    fpm = sub.add_parser("pattern-mining", aliases=["fpm"],
+                         help="frequent flow-pattern mining")
+
+    def fpm_flags(run):
+        run.add_argument("-m", "--min-support", dest="min_support",
+                         type=int, default=0,
+                         help="absolute support threshold (0 = auto: "
+                              "1%% of rows, floor 2)")
+        run.add_argument("-c", "--columns", default="",
+                         help="comma-separated item columns")
+        run.add_argument("--max-len", dest="max_len", type=int,
+                         default=3, choices=[1, 2, 3])
+        run.add_argument("-s", "--start-time", dest="start_time",
+                         default="")
+        run.add_argument("-e", "--end-time", dest="end_time",
+                         default="")
+
+    add_job_commands(fpm, fpm_run, fpm_status, fpm_retrieve, fpm_list,
+                     fpm_delete, fpm_flags)
+
+    sad = sub.add_parser("spatial-anomaly-detection", aliases=["sad"],
+                         help="spatial DBSCAN over flow embeddings")
+
+    def sad_flags(run):
+        run.add_argument("--eps", type=float, default=None)
+        run.add_argument("--min-samples", dest="min_samples", type=int,
+                         default=None)
+        run.add_argument("-s", "--start-time", dest="start_time",
+                         default="")
+        run.add_argument("-e", "--end-time", dest="end_time",
+                         default="")
+
+    add_job_commands(sad, sad_run, sad_status, sad_retrieve, sad_list,
+                     sad_delete, sad_flags)
 
     ch = sub.add_parser("clickhouse")
     chsub = ch.add_subparsers(dest="action", required=True)

@@ -45,7 +45,9 @@ from typing import Dict, Optional, Tuple
 
 from .jobs import (
     KIND_DD,
+    KIND_FPM,
     KIND_NPR,
+    KIND_SPATIAL,
     KIND_TAD,
     STATE_COMPLETED,
     DuplicateJobError,
@@ -76,11 +78,15 @@ _RESOURCE_KIND = {
     "networkpolicyrecommendations": KIND_NPR,
     "throughputanomalydetectors": KIND_TAD,
     "trafficdropdetections": KIND_DD,
+    "flowpatternminings": KIND_FPM,
+    "spatialanomalydetections": KIND_SPATIAL,
 }
 _KIND_NAMES = {
     KIND_NPR: "NetworkPolicyRecommendation",
     KIND_TAD: "ThroughputAnomalyDetector",
     KIND_DD: "TrafficDropDetection",
+    KIND_FPM: "FlowPatternMining",
+    KIND_SPATIAL: "SpatialAnomalyDetection",
 }
 
 
@@ -97,10 +103,9 @@ def record_to_api(record: JobRecord, controller: JobController,
         if record.kind == KIND_NPR:
             doc["status"]["recommendationOutcome"] = (  # type: ignore
                 controller.recommendation_outcome(record.name))
-        elif record.kind == KIND_DD:
-            doc["stats"] = controller.drop_detection_stats(record.name)
         else:
-            doc["stats"] = controller.tad_stats(record.name)
+            doc["stats"] = controller.result_stats(record.kind,
+                                                   record.name)
     return doc
 
 
@@ -535,11 +540,12 @@ class TheiaManagerServer:
                  auth_token: Optional[str] = None,
                  auth_token_file: Optional[str] = None) -> None:
         from .ingest import IngestManager
-        self.controller = JobController(db, workers=workers,
-                                        dispatch=dispatch)
+        self.ingest = IngestManager(db)
+        self.controller = JobController(
+            db, workers=workers, dispatch=dispatch,
+            alert_sink=self.ingest.push_alert)
         self.stats = StatsProvider(db, capacity_bytes=capacity_bytes)
         self.bundles = SupportBundleManager(self.controller, self.stats)
-        self.ingest = IngestManager(db)
         self.auth_token = resolve_auth_token(auth_token,
                                              auth_token_file)
 

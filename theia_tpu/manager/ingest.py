@@ -229,6 +229,12 @@ class IngestManager:
         return self._mappers[column].remap(batch[column],
                                            batch.dicts[column])
 
+    def push_alert(self, alert: Dict[str, object]) -> None:
+        """Publish an externally produced alert (e.g. a completed
+        spatial job's noise flows) onto the ring."""
+        with self._alerts_lock:
+            self._alerts.appendleft({**alert, "time": time.time()})
+
     def recent_alerts(self, limit: int = 100) -> List[Dict[str, object]]:
         with self._alerts_lock:
             return list(self._alerts)[:max(limit, 0)]

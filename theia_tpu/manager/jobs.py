@@ -48,9 +48,10 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..analytics import (TadQuerySpec, run_drop_detection, run_npr,
-                         run_tad)
+                         run_pattern_mining, run_spatial, run_tad)
 from ..runner.__main__ import TIME_FORMAT as RUNNER_TIME_FORMAT
-from ..runner.progress import (DD_STAGES, NPR_STAGES, TAD_STAGES,
+from ..runner.progress import (DD_STAGES, FPM_STAGES, NPR_STAGES,
+                               SPATIAL_STAGES, TAD_STAGES,
                                FileProgress, JobProgress)
 from ..store import FlowDatabase
 from ..utils import get_logger, parse_job_name, validate_policy_type
@@ -66,8 +67,20 @@ STATE_FAILED = "FAILED"
 KIND_NPR = "npr"
 KIND_TAD = "tad"
 KIND_DD = "dd"
+KIND_FPM = "fpm"        # frequent flow-pattern mining
+KIND_SPATIAL = "sad"    # spatial anomaly detection
 
-_NAME_PREFIX = {KIND_NPR: "pr-", KIND_TAD: "tad-", KIND_DD: "dd-"}
+_NAME_PREFIX = {KIND_NPR: "pr-", KIND_TAD: "tad-", KIND_DD: "dd-",
+                KIND_FPM: "fpm-", KIND_SPATIAL: "sad-"}
+
+#: job kind → its result table in FlowDatabase.result_tables
+_RESULT_TABLE = {KIND_NPR: "recommendations", KIND_TAD: "tadetector",
+                 KIND_DD: "dropdetection", KIND_FPM: "flowpatterns",
+                 KIND_SPATIAL: "spatialnoise"}
+
+_STAGES = {KIND_NPR: NPR_STAGES, KIND_TAD: TAD_STAGES,
+           KIND_DD: DD_STAGES, KIND_FPM: FPM_STAGES,
+           KIND_SPATIAL: SPATIAL_STAGES}
 
 #: policy mode → job --option (reference recommend_policies_for_
 #: unprotected_flows, policy_recommendation_job.py:714); shared by
@@ -78,6 +91,16 @@ POLICY_TYPE_OPTION = {"anp-deny-applied": 1, "anp-deny-all": 2,
 
 class DuplicateJobError(Exception):
     """A job with this name already exists (→ HTTP 409)."""
+
+
+def _validate_max_len(spec) -> int:
+    """Pattern-mining maxLen ∈ {1,2,3}, enforced identically in both
+    dispatch modes (the runner's argparse would reject 4+ anyway —
+    thread mode must not silently accept what subprocess mode fails)."""
+    max_len = int(spec.get("maxLen", 3) or 3)
+    if not 1 <= max_len <= 3:
+        raise ValueError(f"maxLen must be 1, 2, or 3, got {max_len}")
+    return max_len
 
 
 def job_id_from_name(kind: str, name: str) -> str:
@@ -123,11 +146,15 @@ class JobController:
     """Reconciles job records into analytics runs over a worker pool."""
 
     def __init__(self, db: FlowDatabase, workers: int = 2,
-                 dispatch: str = "thread") -> None:
+                 dispatch: str = "thread",
+                 alert_sink=None) -> None:
         if dispatch not in ("thread", "subprocess"):
             raise ValueError(f"unknown dispatch mode {dispatch!r}")
         self.db = db
         self.dispatch = dispatch
+        #: optional callable(dict) — completed spatial jobs push their
+        #: noise flows here (the manager wires the ingest alert ring)
+        self.alert_sink = alert_sink
         # One job owns the accelerator at a time in subprocess mode:
         # two children would interleave compilations and thrash HBM.
         self._device_lock = threading.Lock()
@@ -186,8 +213,7 @@ class JobController:
         with self._lock:
             live = {r.job_id for r in self._records.values()}
         removed = 0
-        for table in (self.db.recommendations, self.db.tadetector,
-                      self.db.dropdetection):
+        for table in self.db.result_tables.values():
             data = table.scan()
             if not len(data):
                 continue
@@ -199,9 +225,7 @@ class JobController:
         return removed
 
     def _delete_results(self, kind: str, job_id: str) -> None:
-        table = {KIND_NPR: self.db.recommendations,
-                 KIND_TAD: self.db.tadetector,
-                 KIND_DD: self.db.dropdetection}[kind]
+        table = self.db.result_tables[_RESULT_TABLE[kind]]
         data = table.scan()
         if len(data):
             table.delete_where(data.strings("id") == job_id)
@@ -236,6 +260,12 @@ class JobController:
     def drop_detection_stats(self, name: str) -> List[Dict[str, str]]:
         return self._result_stats(KIND_DD, self.db.dropdetection, name)
 
+    def result_stats(self, kind: str, name: str) -> List[Dict[str, str]]:
+        """Generic result rows for any job kind (the per-kind helpers
+        above remain for the established call sites)."""
+        return self._result_stats(
+            kind, self.db.result_tables[_RESULT_TABLE[kind]], name)
+
     # -- workers ---------------------------------------------------------
 
     def _worker(self) -> None:
@@ -266,6 +296,14 @@ class JobController:
             record.state = STATE_COMPLETED
             logger.v(1).info("job %s completed in %.2fs", record.name,
                              time.time() - record.start_time)
+            if record.kind == KIND_SPATIAL and self.alert_sink:
+                try:
+                    # best-effort side effect: a sink failure must not
+                    # flip a COMPLETED job to FAILED
+                    self._push_spatial_alerts(record)
+                except Exception:
+                    logger.error("job %s: alert push failed\n%s",
+                                 record.name, traceback.format_exc())
         except Exception as e:   # job failure → FAILED CR status
             record.state = STATE_FAILED
             record.error_msg = f"{type(e).__name__}: {e}"
@@ -283,8 +321,61 @@ class JobController:
             if self._deleted(record):
                 self._delete_results(record.kind, record.job_id)
 
+    def _push_spatial_alerts(self, record: JobRecord) -> None:
+        """Surface a completed spatial job's noise flows on the live
+        alert surface (GET /alerts) — batch results feed the streaming
+        ring the way the reference's batch TAD never could. Reads the
+        result table directly (result_stats stringifies every value;
+        alerts carry native types like the other alert kinds)."""
+        table = self.db.result_tables[_RESULT_TABLE[KIND_SPATIAL]]
+        data = table.scan()
+        if not len(data):
+            return
+        rows = data.filter(data.strings("id") == record.job_id)
+        src = rows.strings("sourceIP")
+        dst = rows.strings("destinationIP")
+        ports = np.asarray(rows["destinationTransportPort"])
+        octets = np.asarray(rows["octetDeltaCount"])
+        for i in range(len(rows)):
+            self.alert_sink({
+                "kind": "spatial_noise",
+                "job": record.name,
+                "sourceIP": str(src[i]),
+                "destinationIP": str(dst[i]),
+                "destinationTransportPort": int(ports[i]),
+                "octetDeltaCount": int(octets[i]),
+            })
+
     def _run_inprocess(self, record: JobRecord) -> None:
         spec = record.spec
+        if record.kind == KIND_FPM:
+            from ..analytics.itemsets import DEFAULT_COLUMNS
+            record.progress = JobProgress(record.job_id, FPM_STAGES)
+            run_pattern_mining(
+                self.db,
+                min_support=int(spec.get("minSupport", 0) or 0),
+                columns=tuple(spec.get("columns") or DEFAULT_COLUMNS),
+                max_len=_validate_max_len(spec),
+                start_time=spec.get("startInterval") or None,
+                end_time=spec.get("endInterval") or None,
+                mining_id=record.job_id,
+                progress=record.progress)
+            return
+        if record.kind == KIND_SPATIAL:
+            from ..analytics.spatial import (DEFAULT_EPS,
+                                             DEFAULT_MIN_SAMPLES)
+            record.progress = JobProgress(record.job_id,
+                                          SPATIAL_STAGES)
+            run_spatial(
+                self.db,
+                eps=float(spec.get("eps") or DEFAULT_EPS),
+                min_samples=int(spec.get("minSamples")
+                                or DEFAULT_MIN_SAMPLES),
+                start_time=spec.get("startInterval") or None,
+                end_time=spec.get("endInterval") or None,
+                spatial_id=record.job_id,
+                progress=record.progress)
+            return
         if record.kind == KIND_TAD:
             record.progress = JobProgress(record.job_id, TAD_STAGES)
             run_tad(
@@ -374,6 +465,18 @@ class JobController:
                      "-t", str(spec.get("jobType", "initial"))]
             if spec.get("clusterUUID"):
                 args += ["--cluster-uuid", str(spec["clusterUUID"])]
+        elif record.kind == KIND_FPM:
+            args += ["patterns",
+                     "-m", str(int(spec.get("minSupport", 0) or 0)),
+                     "--max-len", str(_validate_max_len(spec))]
+            if spec.get("columns"):
+                args += ["-c", ",".join(spec["columns"])]
+        elif record.kind == KIND_SPATIAL:
+            args += ["spatial"]
+            if spec.get("eps"):
+                args += ["--eps", str(float(spec["eps"]))]
+            if spec.get("minSamples"):
+                args += ["--min-samples", str(int(spec["minSamples"]))]
         else:
             policy_type = validate_policy_type(
                 str(spec.get("policyType", "anp-deny-applied")))
@@ -417,8 +520,7 @@ class JobController:
         """One job = one runner child over a database snapshot; the
         process boundary is the failure domain (reference Spark
         driver/executor isolation)."""
-        stages = {KIND_TAD: TAD_STAGES, KIND_DD: DD_STAGES,
-                  KIND_NPR: NPR_STAGES}[record.kind]
+        stages = _STAGES[record.kind]
         workdir = tempfile.mkdtemp(
             prefix=f"theia-job-{record.job_id[:8]}-")
         try:
@@ -497,12 +599,9 @@ class JobController:
             logger.error("job %s: runner wrote no results file %s",
                          record.name, results)
             return
-        src = {KIND_NPR: out.recommendations,
-               KIND_TAD: out.tadetector,
-               KIND_DD: out.dropdetection}[record.kind]
-        dst = {KIND_NPR: self.db.recommendations,
-               KIND_TAD: self.db.tadetector,
-               KIND_DD: self.db.dropdetection}[record.kind]
+        table_name = _RESULT_TABLE[record.kind]
+        src = out.result_tables[table_name]
+        dst = self.db.result_tables[table_name]
         data = src.scan()
         if len(data):
             rows = data.filter(data.strings("id") == record.job_id)

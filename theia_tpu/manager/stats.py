@@ -40,9 +40,8 @@ class StatsProvider:
         return rows, nbytes
 
     def disk_infos(self) -> List[Dict[str, str]]:
-        used = (self.db.flows.nbytes + self.db.tadetector.nbytes
-                + self.db.recommendations.nbytes
-                + self.db.dropdetection.nbytes)
+        used = self.db.flows.nbytes + sum(
+            t.nbytes for t in self.db.result_tables.values())
         free = max(self.capacity_bytes - used, 0)
         return [{
             "shard": self.shard,
@@ -55,8 +54,7 @@ class StatsProvider:
 
     def table_infos(self) -> List[Dict[str, str]]:
         out = []
-        for table in (self.db.flows, self.db.tadetector,
-                      self.db.recommendations, self.db.dropdetection):
+        for table in (self.db.flows, *self.db.result_tables.values()):
             out.append({
                 "shard": self.shard,
                 "database": "default",

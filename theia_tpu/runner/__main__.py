@@ -45,14 +45,14 @@ def parse_time(value: Optional[str]) -> Optional[int]:
     return int(dt.replace(tzinfo=datetime.timezone.utc).timestamp())
 
 
-RESULT_TABLES = ("tadetector", "recommendations", "dropdetection")
-
-
 def _save_results(db, args) -> None:
     """--out: results-only snapshot (uncompressed: short-lived handoff
     file); default: full database written back into --db."""
     if getattr(args, "out", None):
-        db.save(args.out, tables=RESULT_TABLES, compress=False)
+        # all result tables, straight from the store registry — a
+        # hand-kept list here silently dropped newly added kinds
+        db.save(args.out, tables=tuple(db.result_tables),
+                compress=False)
     else:
         db.save(args.db)
 
@@ -137,6 +137,42 @@ def build_parser() -> argparse.ArgumentParser:
     dd.add_argument("-i", "--id", default=None)
     dd.add_argument("--progress-file", default=None)
     dd.add_argument("--out", default=None,
+                    help="write result tables only to this .npz "
+                         "(skips saving the full db back to --db)")
+
+    fpm = sub.add_parser("patterns",
+                         help="frequent flow-pattern mining "
+                              "(FP-Growth-equivalent output)")
+    fpm.add_argument("--db", required=True)
+    fpm.add_argument("-m", "--min-support", dest="min_support",
+                     type=int, default=0,
+                     help="absolute support threshold "
+                          "(0 = auto: 1%% of rows, floor 2)")
+    fpm.add_argument("-c", "--columns", default="",
+                     help="comma-separated item columns "
+                          "(default: ns/port/protocol set)")
+    fpm.add_argument("--max-len", dest="max_len", type=int, default=3,
+                     choices=[1, 2, 3])
+    fpm.add_argument("-s", "--start_time", default="")
+    fpm.add_argument("-e", "--end_time", default="")
+    fpm.add_argument("-i", "--id", default=None)
+    fpm.add_argument("--progress-file", default=None)
+    fpm.add_argument("--out", default=None,
+                     help="write result tables only to this .npz "
+                          "(skips saving the full db back to --db)")
+
+    sp = sub.add_parser("spatial",
+                        help="spatial DBSCAN anomaly detection over "
+                             "flow embeddings")
+    sp.add_argument("--db", required=True)
+    sp.add_argument("--eps", type=float, default=None)
+    sp.add_argument("--min-samples", dest="min_samples", type=int,
+                    default=None)
+    sp.add_argument("-s", "--start_time", default="")
+    sp.add_argument("-e", "--end_time", default="")
+    sp.add_argument("-i", "--id", default=None)
+    sp.add_argument("--progress-file", default=None)
+    sp.add_argument("--out", default=None,
                     help="write result tables only to this .npz "
                          "(skips saving the full db back to --db)")
     return p
@@ -231,6 +267,64 @@ def run_dd_job(args) -> str:
     return job_id
 
 
+def run_patterns_job(args) -> str:
+    from ..analytics import run_pattern_mining
+    from ..analytics.itemsets import DEFAULT_COLUMNS
+    from ..store import FlowDatabase
+    from .progress import FPM_STAGES, JobProgress
+
+    progress = JobProgress(args.id or "patterns", FPM_STAGES,
+                           path=args.progress_file)
+    try:
+        db = FlowDatabase.load(args.db)
+        columns = (tuple(c.strip() for c in args.columns.split(",")
+                         if c.strip())
+                   if args.columns else DEFAULT_COLUMNS)
+        job_id = run_pattern_mining(
+            db,
+            min_support=args.min_support,
+            columns=columns,
+            max_len=args.max_len,
+            start_time=parse_time(args.start_time),
+            end_time=parse_time(args.end_time),
+            mining_id=args.id,
+            progress=progress,
+        )
+        _save_results(db, args)
+    except BaseException as e:
+        progress.fail(str(e))
+        raise
+    return job_id
+
+
+def run_spatial_job(args) -> str:
+    from ..analytics import run_spatial
+    from ..analytics.spatial import DEFAULT_EPS, DEFAULT_MIN_SAMPLES
+    from ..store import FlowDatabase
+    from .progress import SPATIAL_STAGES, JobProgress
+
+    progress = JobProgress(args.id or "spatial", SPATIAL_STAGES,
+                           path=args.progress_file)
+    try:
+        db = FlowDatabase.load(args.db)
+        job_id = run_spatial(
+            db,
+            eps=args.eps if args.eps is not None else DEFAULT_EPS,
+            min_samples=(args.min_samples
+                         if args.min_samples is not None
+                         else DEFAULT_MIN_SAMPLES),
+            start_time=parse_time(args.start_time),
+            end_time=parse_time(args.end_time),
+            spatial_id=args.id,
+            progress=progress,
+        )
+        _save_results(db, args)
+    except BaseException as e:
+        progress.fail(str(e))
+        raise
+    return job_id
+
+
 def main(argv=None) -> None:
     # Honor an explicit JAX_PLATFORMS before any backend initializes
     # (deployment sitecustomize hooks may pin the platform
@@ -242,12 +336,11 @@ def main(argv=None) -> None:
         import jax
         jax.config.update("jax_platforms", plats)
     args = build_parser().parse_args(argv)
-    if args.job == "tad":
-        job_id = run_tad_job(args)
-    elif args.job == "npr":
-        job_id = run_npr_job(args)
-    else:
-        job_id = run_dd_job(args)
+    runners = {"tad": run_tad_job, "npr": run_npr_job,
+               "dropdetection": run_dd_job,
+               "patterns": run_patterns_job,
+               "spatial": run_spatial_job}
+    job_id = runners[args.job](args)
     print(json.dumps({"id": job_id, "state": "COMPLETED"}))
 
 

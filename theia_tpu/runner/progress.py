@@ -124,3 +124,5 @@ class FileProgress:
 TAD_STAGES = ["read", "tensorize", "score", "write"]
 NPR_STAGES = ["read", "recommend", "write"]
 DD_STAGES = ["read", "tensorize", "score", "write"]
+FPM_STAGES = ["read", "mine", "write"]
+SPATIAL_STAGES = ["read", "embed", "score", "write"]

@@ -8,6 +8,8 @@ from .flow_schema import (  # noqa: F401
     TADETECTOR_SCHEMA,
     RECOMMENDATIONS_SCHEMA,
     DROPDETECTION_SCHEMA,
+    FLOWPATTERNS_SCHEMA,
+    SPATIALNOISE_SCHEMA,
 )
 from .columnar import (  # noqa: F401
     ColumnarBatch,

@@ -202,3 +202,28 @@ DROPDETECTION_SCHEMA: tuple = _cols(
     ("anomalyDropDate", K.DATETIME),
     ("anomalyDropNumber", K.U64),
 )
+
+# Result table for frequent flow-pattern mining (analytics/itemsets.py;
+# the BASELINE north-star FP-Growth config). `items` is the itemset as
+# "column=value|column=value" (the #/| delimiter convention the NPR
+# peer strings use). No reference table: the reference has no itemset
+# mining.
+FLOWPATTERNS_SCHEMA: tuple = _cols(
+    ("id", K.STRING),
+    ("timeCreated", K.DATETIME),
+    ("items", K.STRING),
+    ("itemsetLength", K.U8),
+    ("support", K.U64),
+)
+
+# Result table for spatial DBSCAN anomaly detection
+# (analytics/spatial.py; BASELINE north-star config 3): one row per
+# noise flow — a flow outside every recurring traffic pattern.
+SPATIALNOISE_SCHEMA: tuple = _cols(
+    ("id", K.STRING),
+    ("timeCreated", K.DATETIME),
+    ("sourceIP", K.STRING),
+    ("destinationIP", K.STRING),
+    ("destinationTransportPort", K.U16),
+    ("octetDeltaCount", K.U64),
+)

@@ -29,11 +29,23 @@ import numpy as np
 from ..schema import (
     DROPDETECTION_SCHEMA,
     FLOW_SCHEMA,
+    FLOWPATTERNS_SCHEMA,
     RECOMMENDATIONS_SCHEMA,
+    SPATIALNOISE_SCHEMA,
     TADETECTOR_SCHEMA,
     ColumnarBatch,
     DictionaryMapper,
     StringDictionary,
+)
+
+#: analytics result tables, in declaration order — the single list the
+#: store, sharded facade, stats, persistence, and job GC iterate
+RESULT_TABLE_SCHEMAS = (
+    ("tadetector", TADETECTOR_SCHEMA),
+    ("recommendations", RECOMMENDATIONS_SCHEMA),
+    ("dropdetection", DROPDETECTION_SCHEMA),
+    ("flowpatterns", FLOWPATTERNS_SCHEMA),
+    ("spatialnoise", SPATIALNOISE_SCHEMA),
 )
 from ..utils.pool import get_pool
 from .views import MATERIALIZED_VIEWS, ViewTable
@@ -276,10 +288,14 @@ class FlowDatabase:
 
     def __init__(self, ttl_seconds: Optional[int] = None) -> None:
         self.flows = Table("flows", FLOW_SCHEMA)
-        self.tadetector = Table("tadetector", TADETECTOR_SCHEMA)
-        self.recommendations = Table("recommendations",
-                                     RECOMMENDATIONS_SCHEMA)
-        self.dropdetection = Table("dropdetection", DROPDETECTION_SCHEMA)
+        self.result_tables: Dict[str, Table] = {
+            name: Table(name, schema)
+            for name, schema in RESULT_TABLE_SCHEMAS}
+        self.tadetector = self.result_tables["tadetector"]
+        self.recommendations = self.result_tables["recommendations"]
+        self.dropdetection = self.result_tables["dropdetection"]
+        self.flowpatterns = self.result_tables["flowpatterns"]
+        self.spatialnoise = self.result_tables["spatialnoise"]
         self.views: Dict[str, ViewTable] = {
             name: ViewTable(name, spec, self.flows.dicts)
             for name, spec in MATERIALIZED_VIEWS.items()}
@@ -358,8 +374,7 @@ class FlowDatabase:
         from ..utils import atomic_write
         from .migration import CURRENT_SCHEMA_VERSION, force
         payload: Dict[str, np.ndarray] = {}
-        for table in (self.flows, self.tadetector, self.recommendations,
-                      self.dropdetection):
+        for table in (self.flows, *self.result_tables.values()):
             if tables is not None and table.name not in tables:
                 continue
             data = table.scan()
@@ -389,8 +404,7 @@ class FlowDatabase:
         with np.load(path, allow_pickle=True) as z:
             payload = {k: z[k] for k in z.files}
         migrate(payload)
-        for table in (db.flows, db.tadetector, db.recommendations,
-                      db.dropdetection):
+        for table in (db.flows, *db.result_tables.values()):
             cols: Dict[str, np.ndarray] = {}
             for name, d in table.dicts.items():
                 key = f"{table.name}/__dict__/{name}"

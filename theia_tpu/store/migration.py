@@ -14,7 +14,9 @@ Schema history (mirrors the reference's column evolution):
   v2 — + `trusted` UInt8                 (subsequent-NPR support)
   v3 — + `egressName`, `egressIP`        (egress observability)
   v4 — + `dropdetection` result table    (traffic-drop detection)
-  v5 — + `tadetector.refitEvery`         (ARIMA refit-cadence audit; current)
+  v5 — + `tadetector.refitEvery`         (ARIMA refit-cadence audit)
+  v6 — + `flowpatterns`, `spatialnoise`  (pattern mining + spatial
+        DBSCAN result tables; current)
 """
 
 from __future__ import annotations
@@ -24,7 +26,7 @@ from typing import Callable, Dict, List
 
 import numpy as np
 
-CURRENT_SCHEMA_VERSION = 5
+CURRENT_SCHEMA_VERSION = 6
 VERSION_KEY = "__schema_version__"
 
 # framework version → schema version (reference VERSION_MAP,
@@ -35,6 +37,7 @@ VERSION_MAP = {
     "0.2.0": 3,
     "0.3.0": 4,
     "0.4.0": 5,
+    "0.5.0": 6,
 }
 
 Payload = Dict[str, np.ndarray]
@@ -94,6 +97,12 @@ MIGRATIONS: List[Migration] = [
         up=lambda p: _add_table_schema_column(p, "tadetector",
                                               "refitEvery"),
         down=lambda p: _drop_key(p, "tadetector/refitEvery")),
+    Migration(
+        version=6, name="add_flowpatterns_spatialnoise_tables",
+        up=lambda p: (_add_empty_table(p, "flowpatterns"),
+                      _add_empty_table(p, "spatialnoise")) and None,
+        down=lambda p: (_drop_table(p, "flowpatterns"),
+                        _drop_table(p, "spatialnoise")) and None),
 ]
 
 
@@ -115,19 +124,22 @@ def _add_table_schema_column(payload: Payload, table: str,
 
 
 def _add_dropdetection(payload: Payload) -> None:
-    """Empty `dropdetection` result table (columns straight from
-    DROPDETECTION_SCHEMA so the migrator can't drift from the live
-    schema; string columns get an ''-seeded dict, the same empty-table
-    layout FlowDatabase.save emits)."""
-    from ..schema import DROPDETECTION_SCHEMA
-    for col in DROPDETECTION_SCHEMA:
+    _add_empty_table(payload, "dropdetection")
+
+
+def _add_empty_table(payload: Payload, table: str) -> None:
+    """Empty result table (columns straight from the live schema so
+    the migrator can't drift from it; string columns get an ''-seeded
+    dict, the same empty-table layout FlowDatabase.save emits)."""
+    from .flow_store import RESULT_TABLE_SCHEMAS
+    schema = dict(RESULT_TABLE_SCHEMAS)[table]
+    for col in schema:
         if col.is_string:
-            payload[f"dropdetection/{col.name}"] = np.zeros(0, np.int32)
-            payload[f"dropdetection/__dict__/{col.name}"] = np.asarray(
+            payload[f"{table}/{col.name}"] = np.zeros(0, np.int32)
+            payload[f"{table}/__dict__/{col.name}"] = np.asarray(
                 [""], dtype=object)
         else:
-            payload[f"dropdetection/{col.name}"] = np.zeros(
-                0, col.host_dtype)
+            payload[f"{table}/{col.name}"] = np.zeros(0, col.host_dtype)
 
 
 def _drop_table(payload: Payload, table: str) -> None:

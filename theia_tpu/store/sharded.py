@@ -191,22 +191,23 @@ class ShardedFlowDatabase:
         # One Generator per table: each DistributedTable serializes its
         # own rand() stream under its own lock; sharing one Generator
         # across tables would race (Generators are not thread-safe).
-        seqs = np.random.SeedSequence(seed).spawn(4)
+        from .flow_store import RESULT_TABLE_SCHEMAS
+        result_names = [name for name, _ in RESULT_TABLE_SCHEMAS]
+        seqs = np.random.SeedSequence(seed).spawn(1 + len(result_names))
         self.ttl_seconds = ttl_seconds
         self.flows = DistributedTable(
             "flows", [s.flows for s in self.shards],
             np.random.default_rng(seqs[0]))
-        self.tadetector = DistributedTable(
-            "tadetector", [s.tadetector for s in self.shards],
-            np.random.default_rng(seqs[1]))
-        self.recommendations = DistributedTable(
-            "recommendations",
-            [s.recommendations for s in self.shards],
-            np.random.default_rng(seqs[2]))
-        self.dropdetection = DistributedTable(
-            "dropdetection",
-            [s.dropdetection for s in self.shards],
-            np.random.default_rng(seqs[3]))
+        self.result_tables: Dict[str, DistributedTable] = {
+            name: DistributedTable(
+                name, [s.result_tables[name] for s in self.shards],
+                np.random.default_rng(seqs[1 + i]))
+            for i, name in enumerate(result_names)}
+        self.tadetector = self.result_tables["tadetector"]
+        self.recommendations = self.result_tables["recommendations"]
+        self.dropdetection = self.result_tables["dropdetection"]
+        self.flowpatterns = self.result_tables["flowpatterns"]
+        self.spatialnoise = self.result_tables["spatialnoise"]
         self.views: Dict[str, DistributedView] = {
             name: DistributedView(name,
                                   [s.views[name] for s in self.shards])
@@ -271,12 +272,10 @@ class ShardedFlowDatabase:
         flows = self.flows.scan()
         if len(flows):
             merged.flows.insert(flows)
-        for src, dst in ((self.tadetector, merged.tadetector),
-                         (self.recommendations, merged.recommendations),
-                         (self.dropdetection, merged.dropdetection)):
+        for name, src in self.result_tables.items():
             data = src.scan()
             if len(data):
-                dst.insert(data)
+                merged.result_tables[name].insert(data)
         merged.save(path, tables=tables, compress=compress)
 
     @classmethod
@@ -292,12 +291,10 @@ class ShardedFlowDatabase:
         flows = single.flows.scan()
         if len(flows):
             db.insert_flows(flows)
-        for src, dst in ((single.tadetector, db.tadetector),
-                         (single.recommendations, db.recommendations),
-                         (single.dropdetection, db.dropdetection)):
+        for name, src in single.result_tables.items():
             data = src.scan()
             if len(data):
-                dst.insert(data)
+                db.result_tables[name].insert(data)
         db.ttl_seconds = ttl_seconds
         for shard in db.shards:
             shard.ttl_seconds = ttl_seconds

@@ -102,7 +102,8 @@ def parse_job_name(name: str, prefix: str) -> str:
 
 def split_job_name(name: str) -> Tuple[str, str]:
     """'pr-<uuid>' → ('pr', '<uuid>'); accepts any known prefix."""
-    for prefix, kind in (("pr-", "pr"), ("tad-", "tad"), ("dd-", "dd")):
+    for prefix, kind in (("pr-", "pr"), ("tad-", "tad"), ("dd-", "dd"),
+                         ("fpm-", "fpm"), ("sad-", "sad")):
         if name.startswith(prefix):
             return kind, parse_job_name(name, prefix)
     raise ValueError(f"unrecognized job name {name!r}")
