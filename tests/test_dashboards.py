@@ -108,6 +108,65 @@ def test_manager_serves_dashboards(db):
         srv.shutdown()
 
 
+def test_chord_renderer_is_a_real_chord(db):
+    """The networkpolicy page renders an actual circular chord diagram
+    (arcs + ribbons, reference ChordPanel.tsx), not a relabeled
+    sankey: ribbons are filled Q-curves through the center, node arcs
+    carry per-entity totals, every entity appears as a label."""
+    from theia_tpu.dashboards.web import svg_chord
+    links = [{"source": "a", "target": "b", "value": 100},
+             {"source": "b", "target": "c", "value": 50},
+             {"source": "c", "target": "a", "value": 25}]
+    svg = svg_chord(links)
+    assert svg.startswith("<svg") and "class='chord'" in svg
+    # ribbons: filled paths with two Q segments through the center
+    assert svg.count("Q") >= 2 * len(links)
+    # node arcs: one closed annular path per entity
+    assert svg.count("<path") == len(links) + 3
+    for n in ("a", "b", "c"):
+        assert f">{n}</text>" in svg
+    # the networkpolicy page uses it
+    page = render("networkpolicy", db)
+    assert "class='chord'" in page
+    # empty input degrades cleanly
+    assert "no data" in svg_chord([])
+
+
+def test_grafana_dashboard_export(db):
+    """?format=grafana returns a Grafana-importable document with the
+    reference's custom panel-type ids."""
+    from theia_tpu.dashboards import grafana_dashboards
+    from theia_tpu.manager import TheiaManagerServer
+
+    docs = grafana_dashboards()
+    assert set(docs) == set(DASHBOARDS)
+    np_doc = docs["networkpolicy"]
+    types = {p["type"] for p in np_doc["panels"]}
+    assert "theia-grafana-chord-plugin" in types
+    assert all("gridPos" in p and "targets" in p
+               for p in np_doc["panels"])
+    sankey_types = {p["type"] for p in docs["pod_to_pod"]["panels"]}
+    assert "theia-grafana-sankey-plugin" in sankey_types
+    assert "theia-grafana-dependency-plugin" in {
+        p["type"] for p in docs["network_topology"]["panels"]}
+    # uids unique and stable
+    uids = [d["uid"] for d in docs.values()]
+    assert len(set(uids)) == len(uids)
+
+    srv = TheiaManagerServer(db, port=0)
+    srv.start_background()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/dashboards/api/"
+                f"networkpolicy?format=grafana", timeout=10) as r:
+            doc = json.loads(r.read())
+        assert doc["uid"] == np_doc["uid"]
+        assert doc["panels"][0]["targets"][0]["urlPath"] == \
+            "/dashboards/api/networkpolicy"
+    finally:
+        srv.shutdown()
+
+
 def test_dashboard_api_time_window_params(db):
     # start/end/limit reach the query functions through the REST layer
     from theia_tpu.manager import TheiaManagerServer

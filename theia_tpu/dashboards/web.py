@@ -97,6 +97,103 @@ def svg_sankey(links: List[Dict[str, object]], width=640,
     return "".join(parts)
 
 
+def svg_chord(links: List[Dict[str, object]], size=520) -> str:
+    """Circular chord diagram: every entity is an arc on one circle
+    (span ∝ its total in+out traffic), every flow a ribbon between its
+    endpoints' arcs — the same layout the reference's d3 chord panel
+    draws (plugins/grafana-custom-plugins/grafana-chord-plugin/src/
+    ChordPanel.tsx, d3.chord over an N×N flow matrix)."""
+    import math
+
+    if not links:
+        return "<p class='empty'>no data</p>"
+    nodes = list(dict.fromkeys(
+        [l["source"] for l in links] + [l["target"] for l in links]))
+    totals = {n: 0.0 for n in nodes}
+    for l in links:
+        v = float(l["value"])
+        totals[l["source"]] += v
+        totals[l["target"]] += v
+    total = sum(totals.values()) or 1.0
+
+    pad = 0.06   # radians between node arcs
+    span = 2 * math.pi - pad * len(nodes)
+    if span <= 0:
+        pad, span = 0.0, 2 * math.pi
+    r_out, r_in = size / 2 - 50, size / 2 - 62
+    cx = cy = size / 2
+
+    def pt(angle: float, r: float):
+        return (cx + r * math.cos(angle - math.pi / 2),
+                cy + r * math.sin(angle - math.pi / 2))
+
+    # Node arc spans + a fill cursor for ribbon sub-arcs (a node's arc
+    # is consumed by its flows in link order, out and in alike).
+    arcs: Dict[str, List[float]] = {}
+    theta = 0.0
+    for n in nodes:
+        width_n = span * totals[n] / total
+        arcs[n] = [theta, theta, width_n]   # start, cursor, width
+        theta += width_n + pad
+
+    def sub_arc(n: str, value: float):
+        a0 = arcs[n][1]
+        a1 = a0 + span * value / total
+        arcs[n][1] = a1
+        return a0, a1
+
+    parts = [f"<svg viewBox='0 0 {size} {size}' class='chord' "
+             f"xmlns='http://www.w3.org/2000/svg'>"]
+    # Ribbons first (under the node arcs).
+    for i, l in enumerate(sorted(links, key=lambda x: -x["value"])):
+        v = float(l["value"])
+        s0, s1 = sub_arc(l["source"], v)
+        t0, t1 = sub_arc(l["target"], v)
+        sx0, sy0 = pt(s0, r_in)
+        sx1, sy1 = pt(s1, r_in)
+        tx0, ty0 = pt(t0, r_in)
+        tx1, ty1 = pt(t1, r_in)
+        large_s = 1 if (s1 - s0) > math.pi else 0
+        large_t = 1 if (t1 - t0) > math.pi else 0
+        c = _PALETTE[nodes.index(l["source"]) % len(_PALETTE)]
+        parts.append(
+            f"<path d='M{sx0:.1f},{sy0:.1f} "
+            f"A{r_in:.1f},{r_in:.1f} 0 {large_s} 1 "
+            f"{sx1:.1f},{sy1:.1f} "
+            f"Q{cx:.1f},{cy:.1f} {tx0:.1f},{ty0:.1f} "
+            f"A{r_in:.1f},{r_in:.1f} 0 {large_t} 1 "
+            f"{tx1:.1f},{ty1:.1f} "
+            f"Q{cx:.1f},{cy:.1f} {sx0:.1f},{sy0:.1f} Z' "
+            f"fill='{c}' opacity='0.45'>"
+            f"<title>{_esc(l['source'])} → {_esc(l['target'])}: "
+            f"{_fmt_bytes(l['value'])}</title></path>")
+    # Node arcs + labels.
+    for n in nodes:
+        a0, _, w = arcs[n]
+        a1 = a0 + w
+        x0, y0 = pt(a0, r_out)
+        x1, y1 = pt(a1, r_out)
+        xi1, yi1 = pt(a1, r_in)
+        xi0, yi0 = pt(a0, r_in)
+        large = 1 if w > math.pi else 0
+        c = _PALETTE[nodes.index(n) % len(_PALETTE)]
+        parts.append(
+            f"<path d='M{x0:.1f},{y0:.1f} "
+            f"A{r_out:.1f},{r_out:.1f} 0 {large} 1 {x1:.1f},{y1:.1f} "
+            f"L{xi1:.1f},{yi1:.1f} "
+            f"A{r_in:.1f},{r_in:.1f} 0 {large} 0 {xi0:.1f},{yi0:.1f} "
+            f"Z' fill='{c}'>"
+            f"<title>{_esc(n)}: {_fmt_bytes(totals[n])}</title></path>")
+        mid = (a0 + a1) / 2
+        lx, ly = pt(mid, r_out + 10)
+        anchor = "start" if math.cos(mid - math.pi / 2) >= 0 else "end"
+        parts.append(f"<text x='{lx:.1f}' y='{ly:.1f}' "
+                     f"text-anchor='{anchor}' class='lbl'>"
+                     f"{_esc(n)}</text>")
+    parts.append("</svg>")
+    return "".join(parts)
+
+
 def svg_lines(ts: Dict[str, object], width=640, height=220) -> str:
     times = ts.get("times", [])
     series = ts.get("series", {})
@@ -262,7 +359,7 @@ def render(name: str, db) -> str:
                 f"<h2>throughput</h2>{svg_lines(data['throughput'])}")
     elif name == "networkpolicy":
         body = (f"<h2>policy traffic (chord)</h2>"
-                f"{svg_sankey(data['chord'])}"
+                f"{svg_chord(data['chord'])}"
                 f"<h2>bytes by rule action</h2>"
                 f"{svg_barlist(data['byAction'])}")
     else:  # network_topology

@@ -351,13 +351,19 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
         """/dashboards/[<name>] → HTML page;
         /dashboards/api/<name>[?start=..&end=..&limit=..&k=..] → the
         underlying JSON data (the Grafana-datasource equivalent of the
-        reference's read path; start/end play the $__timeFilter role)."""
+        reference's read path; start/end play the $__timeFilter role);
+        /dashboards/api/<name>?format=grafana → a Grafana-importable
+        dashboard JSON (the reference's provisioned *.json equivalent,
+        build/charts/theia/provisioning/dashboards/)."""
         import inspect
 
-        from ..dashboards import DASHBOARDS, render
+        from ..dashboards import DASHBOARDS, grafana_dashboard, render
         if len(parts) >= 3 and parts[1] == "api":
-            fn = DASHBOARDS[parts[2]]
             qs = self._query()
+            if qs.get("format") == "grafana":
+                self._send_json(grafana_dashboard(parts[2]))
+                return
+            fn = DASHBOARDS[parts[2]]
             accepted = inspect.signature(fn).parameters
             kwargs = {name: int(qs[name]) for name
                       in ("start", "end", "limit", "k")

@@ -96,8 +96,10 @@ class DuplicateJobError(Exception):
 def _validate_max_len(spec) -> int:
     """Pattern-mining maxLen ∈ {1,2,3}, enforced identically in both
     dispatch modes (the runner's argparse would reject 4+ anyway —
-    thread mode must not silently accept what subprocess mode fails)."""
-    max_len = int(spec.get("maxLen", 3) or 3)
+    thread mode must not silently accept what subprocess mode fails).
+    Absent → 3; 0 is rejected, not coerced."""
+    raw = spec.get("maxLen")
+    max_len = 3 if raw is None else int(raw)
     if not 1 <= max_len <= 3:
         raise ValueError(f"maxLen must be 1, 2, or 3, got {max_len}")
     return max_len
@@ -332,6 +334,19 @@ class JobController:
         if not len(data):
             return
         rows = data.filter(data.strings("id") == record.job_id)
+        # Cap the push: the alert ring is a bounded shared surface
+        # (ingest.MAX_ALERTS slots) — one large batch result must not
+        # evict every live streaming/heavy-hitter alert. Keep the
+        # highest-volume noise flows; the full set stays queryable via
+        # the job's results.
+        cap = 100
+        if len(rows) > cap:
+            logger.info(
+                "job %s: %d noise flows; publishing top %d by bytes",
+                record.name, len(rows), cap)
+            top = np.argsort(
+                np.asarray(rows["octetDeltaCount"]))[-cap:][::-1]
+            rows = rows.take(top)
         src = rows.strings("sourceIP")
         dst = rows.strings("destinationIP")
         ports = np.asarray(rows["destinationTransportPort"])

@@ -67,6 +67,21 @@ def parse_json_list(value: Optional[str]) -> list:
     return parsed
 
 
+def _add_common_job_flags(sp) -> None:
+    """The shared job contract every subcommand carries: database
+    path, time window, job id, progress file, results-only output."""
+    sp.add_argument("--db", required=True,
+                    help="FlowDatabase .npz path")
+    sp.add_argument("-s", "--start_time", default="",
+                    help=f"'{TIME_FORMAT}' UTC")
+    sp.add_argument("-e", "--end_time", default="")
+    sp.add_argument("-i", "--id", default=None)
+    sp.add_argument("--progress-file", default=None)
+    sp.add_argument("--out", default=None,
+                    help="write result tables only to this .npz "
+                         "(skips saving the full db back to --db)")
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="theia_tpu.runner",
@@ -74,14 +89,9 @@ def build_parser() -> argparse.ArgumentParser:
     sub = p.add_subparsers(dest="job", required=True)
 
     tad = sub.add_parser("tad", help="throughput anomaly detection")
-    tad.add_argument("--db", required=True,
-                     help="FlowDatabase .npz path")
+    _add_common_job_flags(tad)
     tad.add_argument("-a", "--algo", required=True,
                      choices=list(TAD_ALGOS))
-    tad.add_argument("-s", "--start_time", default="",
-                     help=f"'{TIME_FORMAT}' UTC")
-    tad.add_argument("-e", "--end_time", default="")
-    tad.add_argument("-i", "--id", default=None)
     tad.add_argument("-n", "--ns-ignore-list", "--ns_ignore_list",
                      dest="ns_ignore_list", default="")
     tad.add_argument("-f", "--agg-flow", dest="agg_flow", default="",
@@ -101,49 +111,31 @@ def build_parser() -> argparse.ArgumentParser:
                      dest="refit_every", type=int, default=1,
                      help="ARIMA refit cadence (1=exact per-step, "
                           "0=auto for long series)")
-    tad.add_argument("--progress-file", default=None)
-    tad.add_argument("--out", default=None,
-                     help="write result tables only to this .npz "
-                          "(skips saving the full db back to --db)")
 
     npr = sub.add_parser("npr", help="network policy recommendation")
-    npr.add_argument("--db", required=True)
+    _add_common_job_flags(npr)
     npr.add_argument("-t", "--type", dest="rec_type", default="initial",
                      choices=["initial", "subsequent"])
     npr.add_argument("-l", "--limit", type=int, default=0)
     npr.add_argument("-o", "--option", type=int, default=1,
                      choices=[1, 2, 3])
-    npr.add_argument("-s", "--start_time", default="")
-    npr.add_argument("-e", "--end_time", default="")
     npr.add_argument("-n", "--ns_allow_list", default="")
-    npr.add_argument("-i", "--id", default=None)
     npr.add_argument("--rm_labels", default="true")
     npr.add_argument("--to_services", default="true")
-    npr.add_argument("--progress-file", default=None)
-    npr.add_argument("--out", default=None,
-                     help="write result tables only to this .npz "
-                          "(skips saving the full db back to --db)")
 
     dd = sub.add_parser("dropdetection",
                         help="abnormal traffic-drop detection "
                              "(theia-sf drop-detection equivalent)")
-    dd.add_argument("--db", required=True)
+    _add_common_job_flags(dd)
     dd.add_argument("-t", "--type", dest="job_type", default="initial",
                     choices=["initial"])
-    dd.add_argument("-s", "--start_time", default="")
-    dd.add_argument("-e", "--end_time", default="")
     dd.add_argument("-c", "--cluster-uuid", dest="cluster_uuid",
                     default="")
-    dd.add_argument("-i", "--id", default=None)
-    dd.add_argument("--progress-file", default=None)
-    dd.add_argument("--out", default=None,
-                    help="write result tables only to this .npz "
-                         "(skips saving the full db back to --db)")
 
     fpm = sub.add_parser("patterns",
                          help="frequent flow-pattern mining "
                               "(FP-Growth-equivalent output)")
-    fpm.add_argument("--db", required=True)
+    _add_common_job_flags(fpm)
     fpm.add_argument("-m", "--min-support", dest="min_support",
                      type=int, default=0,
                      help="absolute support threshold "
@@ -153,28 +145,14 @@ def build_parser() -> argparse.ArgumentParser:
                           "(default: ns/port/protocol set)")
     fpm.add_argument("--max-len", dest="max_len", type=int, default=3,
                      choices=[1, 2, 3])
-    fpm.add_argument("-s", "--start_time", default="")
-    fpm.add_argument("-e", "--end_time", default="")
-    fpm.add_argument("-i", "--id", default=None)
-    fpm.add_argument("--progress-file", default=None)
-    fpm.add_argument("--out", default=None,
-                     help="write result tables only to this .npz "
-                          "(skips saving the full db back to --db)")
 
     sp = sub.add_parser("spatial",
                         help="spatial DBSCAN anomaly detection over "
                              "flow embeddings")
-    sp.add_argument("--db", required=True)
+    _add_common_job_flags(sp)
     sp.add_argument("--eps", type=float, default=None)
     sp.add_argument("--min-samples", dest="min_samples", type=int,
                     default=None)
-    sp.add_argument("-s", "--start_time", default="")
-    sp.add_argument("-e", "--end_time", default="")
-    sp.add_argument("-i", "--id", default=None)
-    sp.add_argument("--progress-file", default=None)
-    sp.add_argument("--out", default=None,
-                    help="write result tables only to this .npz "
-                         "(skips saving the full db back to --db)")
     return p
 
 
