@@ -1,0 +1,219 @@
+"""Concurrency stress: ingest streams vs TTL vs retention vs readers.
+
+The reference runs its whole unit suite under `go test -race`
+(Makefile:101-107); this is the equivalent discipline for the Python/
+C++ runtime — threaded harnesses hammering the shared store and
+asserting ROW CONSERVATION (every acked row is either in the store or
+counted deleted), no deadlocks (bounded joins), and stream-reset
+correctness under interleaving.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from theia_tpu.data.synth import SynthConfig, generate_flows
+from theia_tpu.ingest import BlockEncoder, encode_tsv
+from theia_tpu.manager.ingest import IngestManager
+from theia_tpu.schema import FLOW_SCHEMA, ColumnarBatch
+from theia_tpu.store import FlowDatabase
+
+N_THREADS = 4
+BLOCKS_PER_THREAD = 6
+
+
+def _mk_batch(thread_id: int, block: int, dicts, t_base: int):
+    rows = [{
+        "sourceIP": f"10.{thread_id}.0.{i % 16}",
+        "destinationIP": f"10.{thread_id}.1.{i % 8}",
+        "sourceTransportPort": 30000 + i,
+        "destinationTransportPort": 80,
+        "protocolIdentifier": 6,
+        "octetDeltaCount": 1000 + i,
+        "packetDeltaCount": 3,
+        "throughput": 5000 + i,
+        "timeInserted": t_base + block * 10 + (i % 10),
+        "flowStartSeconds": t_base,
+        "flowEndSeconds": t_base + block * 10 + (i % 10),
+    } for i in range(400)]
+    return ColumnarBatch.from_rows(rows, FLOW_SCHEMA, dicts)
+
+
+def test_concurrent_streams_ttl_retention_readers_conserve_rows():
+    """N producer streams, a TTL/retention trimmer, and view/table
+    readers run concurrently; at the end every acknowledged row is
+    accounted for: still stored, TTL-evicted, or retention-trimmed."""
+    db = FlowDatabase(ttl_seconds=None)
+    im = IngestManager(db)
+    t_base = 1_700_000_000
+    acked = [0] * N_THREADS
+    deleted = []
+    deleted_lock = threading.Lock()
+    stop_aux = threading.Event()
+    errors = []
+
+    def producer(tid):
+        try:
+            enc = BlockEncoder()
+            for b in range(BLOCKS_PER_THREAD):
+                batch = _mk_batch(tid, b, enc.dicts, t_base)
+                out = im.ingest(enc.encode(batch), stream=f"p{tid}")
+                acked[tid] += out["rows"]
+        except Exception as e:   # pragma: no cover - failure surface
+            errors.append(f"producer {tid}: {e!r}")
+
+    def trimmer():
+        # retention trims under a tiny capacity so deletions really
+        # interleave with inserts; deletions are counted for the
+        # conservation check
+        mon = db.monitor(capacity_bytes=1, threshold=0.5,
+                         delete_percentage=0.3, skip_rounds=0)
+        try:
+            while not stop_aux.is_set():
+                n = mon.tick()
+                n += db.delete_flows_older_than(t_base - 10_000)
+                if n:
+                    with deleted_lock:
+                        deleted.append(n)
+                time.sleep(0.002)
+        except Exception as e:   # pragma: no cover
+            errors.append(f"trimmer: {e!r}")
+
+    def reader():
+        try:
+            while not stop_aux.is_set():
+                db.flows.scan()
+                for v in db.views.values():
+                    v.scan()
+                im.recent_alerts(50)
+                time.sleep(0.003)
+        except Exception as e:   # pragma: no cover
+            errors.append(f"reader: {e!r}")
+
+    producers = [threading.Thread(target=producer, args=(i,))
+                 for i in range(N_THREADS)]
+    aux = [threading.Thread(target=trimmer),
+           threading.Thread(target=reader)]
+    for t in aux + producers:
+        t.start()
+    for t in producers:
+        t.join(timeout=300)
+        assert not t.is_alive(), "producer deadlocked"
+    stop_aux.set()
+    for t in aux:
+        t.join(timeout=60)
+        assert not t.is_alive(), "aux thread deadlocked"
+
+    assert not errors, errors
+    total_acked = sum(acked)
+    assert total_acked == N_THREADS * BLOCKS_PER_THREAD * 400
+    with deleted_lock:
+        total_deleted = sum(deleted)
+    remaining = len(db.flows)
+    assert remaining + total_deleted == total_acked, (
+        f"row conservation violated: {remaining} stored + "
+        f"{total_deleted} deleted != {total_acked} acked")
+    assert im.rows_ingested == total_acked
+    # views stayed consistent with the surviving flows
+    pod_view = db.views["flows_pod_view"].scan()
+    flows = db.flows.scan()
+    assert np.asarray(pod_view["octetDeltaCount"]).sum() == \
+        np.asarray(flows["octetDeltaCount"]).sum()
+
+
+def test_concurrent_stream_resets_do_not_desync():
+    """Producers that interleave malformed payloads (stream resets)
+    with fresh encoders still land every good row with correct string
+    identities — a reset must never leave a half-applied dictionary
+    chain behind."""
+    db = FlowDatabase()
+    im = IngestManager(db)
+    good_rows = [0] * N_THREADS
+    errors = []
+
+    def producer(tid):
+        try:
+            for b in range(BLOCKS_PER_THREAD):
+                # malformed payload resets the stream
+                try:
+                    im.ingest(b"garbage-payload", stream=f"r{tid}")
+                    errors.append(f"{tid}: garbage accepted")
+                except ValueError:
+                    pass
+                # fresh encoder after the reset, like a real producer
+                enc = BlockEncoder()
+                batch = ColumnarBatch.from_rows([{
+                    "sourceIP": f"172.16.{tid}.{b}",
+                    "destinationIP": f"172.17.{tid}.{b}",
+                    "octetDeltaCount": 7,
+                    "packetDeltaCount": 1,
+                }], FLOW_SCHEMA, enc.dicts)
+                out = im.ingest(enc.encode(batch), stream=f"r{tid}")
+                good_rows[tid] += out["rows"]
+        except Exception as e:   # pragma: no cover
+            errors.append(f"producer {tid}: {e!r}")
+
+    threads = [threading.Thread(target=producer, args=(i,))
+               for i in range(N_THREADS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+        assert not t.is_alive(), "producer deadlocked"
+    assert not errors, errors
+    assert sum(good_rows) == N_THREADS * BLOCKS_PER_THREAD
+    # decoded identities survived every reset intact
+    flows = db.flows.scan()
+    srcs = set(flows.strings("sourceIP"))
+    for tid in range(N_THREADS):
+        for b in range(BLOCKS_PER_THREAD):
+            assert f"172.16.{tid}.{b}" in srcs
+
+
+def test_concurrent_jobs_and_ingest_no_deadlock():
+    """Job lifecycle (create/read/delete) racing live ingest: the
+    controller's result-table GC and the ingest path share the store;
+    nothing may deadlock and completed jobs must hold valid results."""
+    from theia_tpu.manager.jobs import KIND_TAD, JobController
+
+    db = FlowDatabase()
+    db.insert_flows(generate_flows(SynthConfig(
+        n_series=8, points_per_series=16, anomaly_fraction=0.5,
+        anomaly_magnitude=50.0, seed=3)))
+    im = IngestManager(db)
+    ctl = JobController(db, workers=2)
+    stop = threading.Event()
+    errors = []
+
+    def ingester():
+        try:
+            enc = BlockEncoder()
+            b = 0
+            while not stop.is_set():
+                batch = _mk_batch(9, b, enc.dicts, 1_700_000_000)
+                im.ingest(enc.encode(batch), stream="jobs-race")
+                b += 1
+        except Exception as e:   # pragma: no cover
+            errors.append(f"ingester: {e!r}")
+
+    t = threading.Thread(target=ingester)
+    t.start()
+    try:
+        names = []
+        for _ in range(4):
+            names.append(ctl.create(KIND_TAD, {"jobType": "EWMA"}).name)
+        assert ctl.wait_all(timeout=300)
+        for name in names:
+            rec = ctl.get(name)
+            assert rec.state == "COMPLETED", rec.error_msg
+            assert ctl.tad_stats(name) is not None
+            ctl.delete(name)
+        assert len(db.tadetector) == 0
+    finally:
+        stop.set()
+        t.join(timeout=60)
+        assert not t.is_alive(), "ingester deadlocked"
+        ctl.shutdown()
+    assert not errors, errors

@@ -131,6 +131,54 @@ def test_support_bundle(server):
     assert "stats/diskInfo.json" in names and "jobs.json" in names
 
 
+def test_support_bundle_v2_contents():
+    """Bundle v2 mirrors the reference dumper's component classes
+    (pkg/support/dump.go:55-66): store stats incl. per-shard view,
+    device info, runner log tails, recent alerts, version stamp."""
+    import time
+
+    from theia_tpu.manager.jobs import KIND_TAD, JobController
+    from theia_tpu.store import ShardedFlowDatabase
+
+    db = ShardedFlowDatabase(n_shards=2)
+    db.insert_flows(generate_flows(SynthConfig(
+        n_series=8, points_per_series=20, anomaly_fraction=0.5,
+        anomaly_magnitude=60.0, seed=9)))
+    srv = TheiaManagerServer(db, port=0, workers=1)
+    try:
+        # a subprocess-style runner log tail + an alert to collect
+        # (stderr-emitting child so the tail is deterministically
+        # non-empty)
+        import sys as _sys
+        srv.controller.dispatch = "subprocess"
+        srv.controller._runner_cmd = lambda record, snap, prog: [
+            _sys.executable, "-c",
+            "import sys; print('runner-stderr-marker', "
+            "file=sys.stderr)"]
+        rec = srv.controller.create(KIND_TAD, {"jobType": "EWMA"})
+        assert srv.controller.wait_all(timeout=120)
+        assert rec.state == STATE_COMPLETED, rec.error_msg
+        assert "runner-stderr-marker" in rec.runner_log_tail
+        srv.ingest.push_alert({"kind": "test_alert", "x": 1})
+
+        srv.bundles.create()
+        for _ in range(200):
+            if srv.bundles.status == "collected":
+                break
+            time.sleep(0.05)
+        names = tarfile.open(
+            fileobj=io.BytesIO(srv.bundles.data()),
+            mode="r:gz").getnames()
+        for expected in ("stats/diskInfo.json", "stats/insertRate.json",
+                         "stats/deviceInfo.json", "store/shards.json",
+                         "jobs.json", "logs/theia-manager.log",
+                         f"logs/runner-{rec.name}.log",
+                         "alerts.json", "version.json"):
+            assert expected in names, expected
+    finally:
+        srv.shutdown()
+
+
 def test_gc_stale_results():
     db = FlowDatabase()
     db.tadetector.insert_rows([{"id": "dead-beef", "anomaly": "true"}])

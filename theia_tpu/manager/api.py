@@ -112,12 +112,16 @@ def record_to_api(record: JobRecord, controller: JobController,
 class SupportBundleManager:
     """Async support-bundle collection (reference supportBundleREST:
     Create spawns a collect goroutine, status polls, then download —
-    rest.go:115-255,425)."""
+    rest.go:115-255,425). Contents mirror the reference ManagerDumper's
+    component classes (pkg/support/dump.go:55-66): store stats (whole
+    + per shard), device inventory, manager + runner logs, job records
+    with progress, and recent alerts."""
 
     def __init__(self, controller: JobController,
-                 stats: StatsProvider) -> None:
+                 stats: StatsProvider, ingest=None) -> None:
         self.controller = controller
         self.stats = stats
+        self.ingest = ingest
         self.status = "none"
         self._data: Optional[bytes] = None
         self._lock = threading.Lock()
@@ -145,8 +149,30 @@ class SupportBundleManager:
                     json.dumps(self.stats.disk_infos(), indent=2))
                 add("stats/tableInfo.json",
                     json.dumps(self.stats.table_infos(), indent=2))
+                add("stats/insertRate.json",
+                    json.dumps(self.stats.insert_rates(), indent=2))
                 add("stats/stackTraces.json",
                     json.dumps(self.stats.stack_traces(), indent=2))
+                try:
+                    # touches jax.devices(): collected best-effort so a
+                    # wedged accelerator can't block the whole bundle
+                    add("stats/deviceInfo.json",
+                        json.dumps(self.stats.device_infos(),
+                                   indent=2))
+                except Exception as e:
+                    add("stats/deviceInfo.json",
+                        json.dumps({"error": str(e)}))
+                # Per-shard store summary (sharded deployments): which
+                # shard holds what — the Distributed-table operator view.
+                db = self.controller.db
+                if hasattr(db, "shards"):
+                    add("store/shards.json", json.dumps([
+                        {"shard": i,
+                         "flows": len(s.flows),
+                         "flowBytes": s.flows.nbytes,
+                         **{name: len(t) for name, t
+                            in s.result_tables.items()}}
+                        for i, s in enumerate(db.shards)], indent=2))
                 add("jobs.json", json.dumps(
                     [record_to_api(r, self.controller)
                      for r in self.controller.list()], indent=2,
@@ -156,6 +182,24 @@ class SupportBundleManager:
                 # (pkg/support/dump.go:55-66); here the in-process ring
                 # buffer is the log source.
                 add("logs/theia-manager.log", dump_logs())
+                # Runner children's stderr tails (the Spark driver/
+                # executor pod-log class), one file per dispatched job.
+                for r in self.controller.list():
+                    if r.runner_log_tail:
+                        add(f"logs/runner-{r.name}.log",
+                            r.runner_log_tail)
+                if self.ingest is not None:
+                    from .ingest import MAX_ALERTS
+                    add("alerts.json", json.dumps(
+                        self.ingest.recent_alerts(MAX_ALERTS),
+                        indent=2, default=str))
+                from .. import __version__
+                from ..store.migration import CURRENT_SCHEMA_VERSION
+                add("version.json", json.dumps({
+                    "version": __version__,
+                    "schemaVersion": CURRENT_SCHEMA_VERSION,
+                    "dispatch": self.controller.dispatch,
+                }, indent=2))
             with self._lock:
                 self._data = buf.getvalue()
                 self.status = "collected"
@@ -551,7 +595,8 @@ class TheiaManagerServer:
             db, workers=workers, dispatch=dispatch,
             alert_sink=self.ingest.push_alert)
         self.stats = StatsProvider(db, capacity_bytes=capacity_bytes)
-        self.bundles = SupportBundleManager(self.controller, self.stats)
+        self.bundles = SupportBundleManager(self.controller, self.stats,
+                                            ingest=self.ingest)
         self.auth_token = resolve_auth_token(auth_token,
                                              auth_token_file)
 

@@ -122,6 +122,7 @@ class JobRecord:
     end_time: float = 0.0
     progress: Optional[object] = None   # JobProgress | FileProgress
     runner_pid: int = 0                 # subprocess dispatch only
+    runner_log_tail: str = ""           # child stderr tail (bundle)
 
     @property
     def job_id(self) -> str:
@@ -587,10 +588,18 @@ class JobController:
                     raise
             # final scrape before the scratch dir goes away
             record.progress.snapshot()
-            if proc.returncode != 0:
+            try:
+                # keep the child's stderr tail on the record — the
+                # support bundle's runner-log source (the reference
+                # dumper copies Spark driver/executor pod logs,
+                # pkg/support/dump.go:55-66)
                 with open(err_path, "rb") as f:
-                    err = f.read()[-8192:]
-                tail = " | ".join(err.decode(errors="replace")
+                    record.runner_log_tail = f.read()[-8192:].decode(
+                        errors="replace")
+            except OSError:
+                pass
+            if proc.returncode != 0:
+                tail = " | ".join(record.runner_log_tail
                                   .strip().splitlines()[-5:])
                 sig = (f"killed by signal {-proc.returncode}"
                        if proc.returncode < 0
