@@ -3,41 +3,59 @@
 
 Counterpart of the reference's hack/generate-manifest.sh (options
 --spark-operator/--theia-manager/--no-grafana/--ch-size/
---ch-monitor-threshold): emits a single Kubernetes YAML deploying the
-theia-tpu stack into the `flow-visibility` namespace. There is no
-ClickHouse operator, ZooKeeper, Grafana or Spark operator to deploy —
-the store, dashboards and analytics engine live inside the manager
-process; the runner image exists for out-of-process batch jobs on TPU
-node pools.
+--ch-monitor-threshold) plus its theia-cli RBAC templates
+(build/charts/theia/templates/theia-cli: ServiceAccount + Role +
+RoleBinding so the CLI can read its token and port-forward the
+manager). Emits a single Kubernetes YAML deploying the theia-tpu
+stack into the `flow-visibility` namespace. There is no ClickHouse
+operator, ZooKeeper, Grafana or Spark operator to deploy — the store,
+dashboards and analytics engine live inside the manager process; the
+runner image exists for out-of-process batch jobs on TPU node pools.
 
 Usage:
-  python deploy/generate_manifest.py [--no-manager] [--tls]
-      [--capacity-bytes N] [--ttl-seconds N] [--namespace NS]
-      > flow-visibility.yml
+  python deploy/generate_manifest.py [--no-manager] [--tls] [--auth]
+      [--pvc SIZE] [--dispatch thread|subprocess]
+      [--checkpoint-interval N] [--capacity-bytes N] [--ttl-seconds N]
+      [--namespace NS] > flow-visibility.yml
 """
 
 from __future__ import annotations
 
 import argparse
+import secrets
 import sys
 
 
-def manifest(namespace: str, manager: bool, tls: bool,
-             capacity_bytes: int, ttl_seconds: int,
-             image: str) -> str:
-    docs = [f"""\
-apiVersion: v1
-kind: Namespace
-metadata:
-  name: {namespace}
-  labels:
-    app: theia-tpu
-"""]
-    if manager:
-        tls_args = """
+def _manager_deployment(namespace: str, tls: bool, auth: bool,
+                        capacity_bytes: int, ttl_seconds: int,
+                        image: str, pvc: str, dispatch: str,
+                        checkpoint_interval: int) -> str:
+    extra_args = ""
+    if tls:
+        extra_args += """
             - --tls-cert-dir
-            - /certs""" if tls else ""
-        docs.append(f"""\
+            - /certs"""
+    if dispatch != "thread":
+        extra_args += f"""
+            - --dispatch
+            - {dispatch}"""
+    extra_args += f"""
+            - --checkpoint-interval
+            - "{checkpoint_interval}\""""
+    auth_env = """
+            - name: THEIA_AUTH_TOKEN
+              valueFrom:
+                secretKeyRef:
+                  name: theia-api-token
+                  key: token""" if auth else ""
+    data_volume = f"""\
+        - name: data
+          persistentVolumeClaim:
+            claimName: theia-manager-data""" if pvc else f"""\
+        - name: data
+          emptyDir:
+            sizeLimit: {max(capacity_bytes // (1 << 30), 1)}Gi"""
+    return f"""\
 apiVersion: apps/v1
 kind: Deployment
 metadata:
@@ -55,6 +73,7 @@ spec:
       labels:
         app: theia-manager
     spec:
+      serviceAccountName: theia-manager
       containers:
         - name: theia-manager
           image: {image}
@@ -64,14 +83,14 @@ spec:
             - --address
             - 0.0.0.0
             - --capacity-bytes
-            - "{capacity_bytes}"{tls_args}
+            - "{capacity_bytes}"{extra_args}
           env:
             - name: POD_NAMESPACE
               valueFrom:
                 fieldRef:
                   fieldPath: metadata.namespace
             - name: THEIA_TTL_SECONDS
-              value: "{ttl_seconds}"
+              value: "{ttl_seconds}"{auth_env}
           ports:
             - containerPort: 11347
               name: api
@@ -87,12 +106,110 @@ spec:
             - name: certs
               mountPath: /certs
       volumes:
-        - name: data
-          emptyDir:
-            sizeLimit: {max(capacity_bytes // (1 << 30), 1)}Gi
+{data_volume}
         - name: certs
           emptyDir: {{}}
+"""
+
+
+def _rbac(namespace: str, auth: bool) -> list:
+    """theia-cli access plumbing, mirroring the reference's
+    theia-cli templates: a ServiceAccount an operator can `kubectl
+    exec`/impersonate, a Role reading the API token Secret and
+    port-forwarding the manager Service, and the binding."""
+    docs = [f"""\
+apiVersion: v1
+kind: ServiceAccount
+metadata:
+  name: theia-cli
+  namespace: {namespace}
+""", f"""\
+apiVersion: rbac.authorization.k8s.io/v1
+kind: Role
+metadata:
+  name: theia-cli
+  namespace: {namespace}
+rules:
+  - apiGroups: [""]
+    resources: ["services"]
+    resourceNames: ["theia-manager"]
+    verbs: ["get"]
+  - apiGroups: [""]
+    resources: ["pods"]
+    verbs: ["get", "list"]
+  - apiGroups: [""]
+    resources: ["pods/portforward"]
+    verbs: ["create"]"""
+            + ("""
+  - apiGroups: [""]
+    resources: ["secrets"]
+    resourceNames: ["theia-api-token"]
+    verbs: ["get"]
+""" if auth else "\n"), f"""\
+apiVersion: rbac.authorization.k8s.io/v1
+kind: RoleBinding
+metadata:
+  name: theia-cli
+  namespace: {namespace}
+roleRef:
+  apiGroup: rbac.authorization.k8s.io
+  kind: Role
+  name: theia-cli
+subjects:
+  - kind: ServiceAccount
+    name: theia-cli
+    namespace: {namespace}
+"""]
+    return docs
+
+
+def manifest(namespace: str, manager: bool, tls: bool,
+             capacity_bytes: int, ttl_seconds: int,
+             image: str, auth: bool = False, pvc: str = "",
+             dispatch: str = "thread",
+             checkpoint_interval: int = 60,
+             token: str = "") -> str:
+    docs = [f"""\
+apiVersion: v1
+kind: Namespace
+metadata:
+  name: {namespace}
+  labels:
+    app: theia-tpu
+"""]
+    if manager:
+        if auth:
+            # Render-time random token (the self-signed-cert
+            # discipline applied to authn): manager env and CLI both
+            # read this Secret, the reference's ServiceAccount-token
+            # Secret role.
+            token = token or secrets.token_hex(32)
+            docs.append(f"""\
+apiVersion: v1
+kind: Secret
+metadata:
+  name: theia-api-token
+  namespace: {namespace}
+type: Opaque
+stringData:
+  token: {token}
 """)
+        if pvc:
+            docs.append(f"""\
+apiVersion: v1
+kind: PersistentVolumeClaim
+metadata:
+  name: theia-manager-data
+  namespace: {namespace}
+spec:
+  accessModes: ["ReadWriteOnce"]
+  resources:
+    requests:
+      storage: {pvc}
+""")
+        docs.append(_manager_deployment(
+            namespace, tls, auth, capacity_bytes, ttl_seconds, image,
+            pvc, dispatch, checkpoint_interval))
         docs.append(f"""\
 apiVersion: v1
 kind: Service
@@ -116,6 +233,7 @@ metadata:
   name: theia-manager
   namespace: {namespace}
 """)
+        docs.extend(_rbac(namespace, auth))
     return "---\n".join(docs)
 
 
@@ -124,13 +242,24 @@ def main(argv=None) -> None:
     p.add_argument("--namespace", default="flow-visibility")
     p.add_argument("--no-manager", action="store_true")
     p.add_argument("--tls", action="store_true")
+    p.add_argument("--auth", action="store_true",
+                   help="bearer-token authn: Secret + manager env + "
+                        "CLI read RBAC")
+    p.add_argument("--pvc", default="",
+                   help="PersistentVolumeClaim size for /data (e.g. "
+                        "16Gi); default emptyDir")
+    p.add_argument("--dispatch", default="thread",
+                   choices=["thread", "subprocess"])
+    p.add_argument("--checkpoint-interval", type=int, default=60)
     p.add_argument("--capacity-bytes", type=int, default=8 << 30)
     p.add_argument("--ttl-seconds", type=int, default=12 * 3600)
     p.add_argument("--image", default="theia-tpu/manager:latest")
     args = p.parse_args(argv)
     sys.stdout.write(manifest(
         args.namespace, not args.no_manager, args.tls,
-        args.capacity_bytes, args.ttl_seconds, args.image))
+        args.capacity_bytes, args.ttl_seconds, args.image,
+        auth=args.auth, pvc=args.pvc, dispatch=args.dispatch,
+        checkpoint_interval=args.checkpoint_interval))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,95 @@
+"""Deployment manifest generator: RBAC, auth secret, PVC, dispatch.
+
+Counterpart coverage for the reference's hack/generate-manifest.sh
+variants and theia-cli RBAC templates
+(build/charts/theia/templates/theia-cli).
+"""
+
+import importlib.util
+import os
+
+import pytest
+
+yaml = pytest.importorskip("yaml")
+
+_SPEC = importlib.util.spec_from_file_location(
+    "generate_manifest",
+    os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "deploy", "generate_manifest.py"))
+gm = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(gm)
+
+
+def _docs(**kw):
+    defaults = dict(namespace="flow-visibility", manager=True,
+                    tls=False, capacity_bytes=8 << 30,
+                    ttl_seconds=3600, image="img:latest")
+    defaults.update(kw)
+    return [d for d in yaml.safe_load_all(gm.manifest(**defaults))
+            if d]
+
+
+def _kinds(docs):
+    return [(d["kind"], d["metadata"]["name"]) for d in docs]
+
+
+def test_default_manifest_is_valid_yaml_with_rbac():
+    docs = _docs()
+    kinds = _kinds(docs)
+    assert ("Namespace", "flow-visibility") in kinds
+    assert ("Deployment", "theia-manager") in kinds
+    assert ("Service", "theia-manager") in kinds
+    assert ("ServiceAccount", "theia-manager") in kinds
+    # CLI RBAC (reference theia-cli templates)
+    assert ("ServiceAccount", "theia-cli") in kinds
+    assert ("Role", "theia-cli") in kinds
+    assert ("RoleBinding", "theia-cli") in kinds
+    role = next(d for d in docs if d["kind"] == "Role")
+    resources = {r for rule in role["rules"]
+                 for r in rule["resources"]}
+    assert "pods/portforward" in resources
+    # no auth: no secret, and the Role must not grant secret reads
+    assert not any(k == "Secret" for k, _ in kinds)
+    assert "secrets" not in resources
+
+
+def test_auth_adds_secret_env_and_rbac():
+    docs = _docs(auth=True, token="tok123")
+    secret = next(d for d in docs if d["kind"] == "Secret")
+    assert secret["stringData"]["token"] == "tok123"
+    dep = next(d for d in docs if d["kind"] == "Deployment")
+    env = dep["spec"]["template"]["spec"]["containers"][0]["env"]
+    auth_env = next(e for e in env if e["name"] == "THEIA_AUTH_TOKEN")
+    assert auth_env["valueFrom"]["secretKeyRef"]["name"] == \
+        "theia-api-token"
+    role = next(d for d in docs if d["kind"] == "Role")
+    secret_rules = [r for r in role["rules"]
+                    if "secrets" in r["resources"]]
+    assert secret_rules and \
+        secret_rules[0]["resourceNames"] == ["theia-api-token"]
+
+
+def test_pvc_and_dispatch_and_checkpoint():
+    docs = _docs(pvc="16Gi", dispatch="subprocess",
+                 checkpoint_interval=30)
+    pvc = next(d for d in docs
+               if d["kind"] == "PersistentVolumeClaim")
+    assert pvc["spec"]["resources"]["requests"]["storage"] == "16Gi"
+    dep = next(d for d in docs if d["kind"] == "Deployment")
+    spec = dep["spec"]["template"]["spec"]
+    args = spec["containers"][0]["args"]
+    assert "--dispatch" in args and "subprocess" in args
+    assert "--checkpoint-interval" in args and "30" in args
+    vols = {v["name"]: v for v in spec["volumes"]}
+    assert "persistentVolumeClaim" in vols["data"]
+
+
+def test_no_manager_renders_namespace_only():
+    docs = _docs(manager=False)
+    assert _kinds(docs) == [("Namespace", "flow-visibility")]
+
+
+def test_random_token_when_not_supplied():
+    docs = _docs(auth=True)
+    secret = next(d for d in docs if d["kind"] == "Secret")
+    assert len(secret["stringData"]["token"]) == 64

@@ -138,6 +138,35 @@ def test_score_series_mesh_pads_and_slices(eight_devices, rng):
     np.testing.assert_array_equal(a_sh, a_lo)
 
 
+def test_long_series_auto_time_sharding(eight_devices, rng):
+    """Fewer series than devices + long T: EWMA re-shards over TIME
+    (sequence parallelism) instead of falling back to one device —
+    results match the local kernel up to the documented psum stddev
+    approximation (Weak r4 #8: time sharding now has a production
+    policy)."""
+    from theia_tpu.analytics.tad import LONG_SERIES_T, score_series
+
+    mesh = make_mesh(8, time_shards=1)
+    S, T = 3, LONG_SERIES_T          # 3 series over 8 devices
+    x = rng.uniform(1e5, 1e7, size=(S, T))
+    mask = np.ones((S, T), bool)
+    c_sh, s_sh, a_sh = score_series(x, mask, "EWMA", mesh=mesh)
+    c_lo, s_lo, a_lo = score_series(x, mask, "EWMA")
+    assert c_sh.shape == (S, T)
+    np.testing.assert_allclose(c_sh, c_lo, rtol=1e-6)
+    np.testing.assert_allclose(s_sh, s_lo, rtol=1e-6)
+    # anomaly flags may flip only exactly on the threshold boundary
+    assert (a_sh == a_lo).mean() > 0.999
+
+    # below the threshold the local path still wins (no re-mesh)
+    xs = rng.uniform(1e5, 1e7, size=(3, 64))
+    ms = np.ones((3, 64), bool)
+    c2, _, a2 = score_series(xs, ms, "EWMA", mesh=mesh)
+    c2_lo, _, a2_lo = score_series(xs, ms, "EWMA")
+    np.testing.assert_allclose(c2, c2_lo, rtol=1e-12)
+    np.testing.assert_array_equal(a2, a2_lo)
+
+
 def test_run_tad_sharded_rows_match_single_device(eight_devices):
     # The production job entry point over a mesh emits the same
     # tadetector rows as single-device (exact under the x64 conftest).

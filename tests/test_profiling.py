@@ -1,0 +1,122 @@
+"""XLA profiler capture over the system API + CLI.
+
+SURVEY §5: the reference's runtime introspection stops at Spark-UI
+scraping and ClickHouse system tables; the TPU build adds a real
+accelerator profiler surface (§7.7 "XLA-profile hooks — cheap win").
+"""
+
+import io
+import tarfile
+import threading
+import time
+
+import pytest
+
+from theia_tpu.cli.__main__ import main as cli_main
+from theia_tpu.data.synth import SynthConfig, generate_flows
+from theia_tpu.manager import TheiaManagerServer
+from theia_tpu.manager.profiling import ProfileManager
+from theia_tpu.store import FlowDatabase
+
+
+def _busy_device(stop):
+    import jax.numpy as jnp
+    x = jnp.ones((256, 256))
+    while not stop.is_set():
+        (x @ x).block_until_ready()
+
+
+def test_profile_manager_captures_trace():
+    pm = ProfileManager()
+    stop = threading.Event()
+    t = threading.Thread(target=_busy_device, args=(stop,),
+                         daemon=True)
+    t.start()
+    try:
+        doc = pm.create(duration_seconds=0.5)
+        assert doc["status"] == "collecting"
+        deadline = time.time() + 60
+        while pm.status == "collecting" and time.time() < deadline:
+            time.sleep(0.05)
+        assert pm.status == "collected", pm.to_api()
+        data = pm.data()
+        assert data
+        names = tarfile.open(fileobj=io.BytesIO(data),
+                             mode="r:gz").getnames()
+        assert names, "trace directory should contain profile files"
+    finally:
+        stop.set()
+        t.join(timeout=10)
+
+
+def test_second_create_during_capture_does_not_deadlock(monkeypatch):
+    """POST while collecting must answer (status collecting), not
+    deadlock on the manager's own lock, and must not serve the
+    previous capture's data as the new one."""
+    from theia_tpu.manager import profiling
+    monkeypatch.setattr(profiling, "MAX_DURATION_SECONDS", 1.0)
+    pm = ProfileManager()
+    pm.create(duration_seconds=1.0)
+    doc = pm.create(duration_seconds=1.0)   # second, while in flight
+    assert doc["status"] == "collecting"
+    assert pm.data() is None                # no stale trace served
+    deadline = time.time() + 60
+    while pm.status == "collecting" and time.time() < deadline:
+        time.sleep(0.05)
+    assert pm.status == "collected"
+
+
+def test_profile_duration_capped(monkeypatch):
+    from theia_tpu.manager import profiling
+    # shrink the cap so the capture (which holds the GLOBAL jax
+    # profiler) finishes within the test
+    monkeypatch.setattr(profiling, "MAX_DURATION_SECONDS", 0.3)
+    pm = ProfileManager()
+    doc = pm.create(duration_seconds=10_000)
+    assert doc["durationSeconds"] <= 0.3
+    deadline = time.time() + 60
+    while pm.status == "collecting" and time.time() < deadline:
+        time.sleep(0.05)
+    assert pm.status == "collected", pm.to_api()
+
+
+def test_profile_cli_end_to_end(tmp_path, capsys):
+    db = FlowDatabase()
+    db.insert_flows(generate_flows(SynthConfig(
+        n_series=4, points_per_series=10, seed=4)))
+    srv = TheiaManagerServer(db, port=0)
+    srv.start_background()
+    stop = threading.Event()
+    t = threading.Thread(target=_busy_device, args=(stop,),
+                         daemon=True)
+    t.start()
+    try:
+        out = tmp_path / "prof.tar.gz"
+        cli_main(["--manager-addr", f"http://127.0.0.1:{srv.port}",
+                  "profile", "-d", "0.5", "-f", str(out)])
+        assert "XLA profile written" in capsys.readouterr().out
+        assert out.stat().st_size > 0
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        srv.shutdown()
+
+
+def test_profile_requires_auth_when_enabled():
+    import json
+    import urllib.error
+    import urllib.request
+
+    srv = TheiaManagerServer(FlowDatabase(), port=0,
+                             auth_token="secret")
+    srv.start_background()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/apis/"
+            "system.theia.antrea.io/v1alpha1/profiles",
+            method="POST", data=json.dumps({}).encode())
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=10)
+        assert e.value.code == 401
+    finally:
+        srv.shutdown()

@@ -27,6 +27,11 @@ from .series import SeriesBatch, TadQuerySpec, build_series
 
 logger = get_logger("tad")
 
+#: series length at which an under-populated mesh (fewer series than
+#: devices) re-shards EWMA over TIME instead of running local — below
+#: this the blockwise scan's collective overhead beats its win
+LONG_SERIES_T = 4096
+
 ALGORITHMS = ("EWMA", "ARIMA", "DBSCAN")
 
 
@@ -100,19 +105,39 @@ def score_series(values: np.ndarray, mask: np.ndarray, algo: str,
     `refit_every` applies to ARIMA only (see `effective_refit`).
     With `mesh` (a jax.sharding.Mesh with >1 device), scoring shards
     over the mesh; results are identical to the local path for
-    series-sharded meshes (time_shards=1 — the job_mesh() default). An
-    explicitly time-sharded mesh routes EWMA through the psum-reduced
-    stddev, which is only bit-approximate: anomaly flags at exact
-    threshold boundaries can differ (route through make_series_sharded
-    when exactness is required).
+    series-sharded meshes (time_shards=1 — the job_mesh() default).
+    Time sharding engages in two cases, both bit-approximate in the
+    psum-reduced stddev (anomaly flags exactly ON the threshold can
+    differ): an explicitly time-sharded mesh, or automatically for
+    EWMA when the batch has fewer series than devices and T ≥
+    LONG_SERIES_T (sequence parallelism instead of idle devices).
     """
     if algo not in ALGORITHMS:
         raise ValueError(
             f"algo must be one of {ALGORITHMS}, got {algo!r}")
-    if mesh is not None and mesh.size > 1 and \
-            values.shape[0] >= mesh.size:
-        return _score_series_sharded(values, mask, algo, refit_every,
-                                     mesh)
+    if mesh is not None and mesh.size > 1:
+        if values.shape[0] >= mesh.size:
+            return _score_series_sharded(values, mask, algo,
+                                         refit_every, mesh)
+        if algo == "EWMA" and values.shape[1] >= LONG_SERIES_T:
+            # Few series, long T: series-DP would idle most devices,
+            # so re-mesh the same devices sequence-parallel and scan
+            # the TIME axis cooperatively (the long-time-series role
+            # SURVEY §5 assigns to sequence sharding). The psum'd
+            # stddev is bit-approximate vs the local kernel — anomaly
+            # flags exactly ON the threshold can flip; worth it only
+            # when T is long enough for the blockwise scan to pay.
+            from ..parallel.mesh import make_mesh
+            tmesh = make_mesh(devices=mesh.devices.flatten(),
+                              time_shards=mesh.devices.size)
+            logger.info(
+                "EWMA over %d series x %d steps: sequence-parallel "
+                "time sharding over %d devices (series-DP would idle "
+                "%d of them)", values.shape[0], values.shape[1],
+                tmesh.devices.size,
+                mesh.devices.size - values.shape[0])
+            return _score_series_sharded(values, mask, algo,
+                                         refit_every, tmesh)
     if algo == "EWMA":
         calc, std, anom = ewma_scores(values, mask)
     elif algo == "ARIMA":

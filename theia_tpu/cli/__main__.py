@@ -510,27 +510,55 @@ def clickhouse_status(args) -> None:
             _print_table(rows, list(rows[0].keys()))
 
 
-def supportbundle(args) -> None:
-    path = "/apis/system.theia.antrea.io/v1alpha1/supportbundles"
-    _request(args.manager_addr, "POST", path)
-    deadline = time.time() + 60
+def _poll_and_download(addr: str, path: str, wait_s: float,
+                       out_path: str, label: str) -> int:
+    """Shared async-collect client: poll status until collected (or
+    failed), then stream .../theia-manager/download to `out_path`.
+    Returns the byte count."""
+    deadline = time.time() + wait_s
     while time.time() < deadline:
-        doc = _request(args.manager_addr, "GET", path)
-        if doc.get("status") == "collected":
+        doc = _request(addr, "GET", path)
+        status = doc.get("status")
+        if status == "collected":
             break
+        if status == "failed":
+            raise APIError(
+                f"error: {label} failed: {doc.get('errorMsg', '')}")
         time.sleep(0.5)
     else:
-        raise APIError("error: support bundle collection timed out")
+        raise APIError(f"error: {label} collection timed out")
     req = urllib.request.Request(
-        args.manager_addr + path + "/theia-manager/download",
+        addr + path + "/theia-manager/download",
         headers=_auth_headers())
     with urllib.request.urlopen(req, timeout=60,
                                 context=_url_context()) as resp:
         data = resp.read()
-    out = args.file or "theia-supportbundle.tar.gz"
-    with open(out, "wb") as f:
+    with open(out_path, "wb") as f:
         f.write(data)
-    print(f"Support bundle written to {out} ({len(data)} bytes)")
+    return len(data)
+
+
+def supportbundle(args) -> None:
+    path = "/apis/system.theia.antrea.io/v1alpha1/supportbundles"
+    _request(args.manager_addr, "POST", path)
+    out = args.file or "theia-supportbundle.tar.gz"
+    n = _poll_and_download(args.manager_addr, path, 60, out,
+                           "support bundle")
+    print(f"Support bundle written to {out} ({n} bytes)")
+
+
+def profile(args) -> None:
+    """Capture an XLA profiler trace from the manager (no reference
+    equivalent — its closest surface is the ClickHouse stack-trace
+    dump)."""
+    path = "/apis/system.theia.antrea.io/v1alpha1/profiles"
+    _request(args.manager_addr, "POST", path,
+             {"durationSeconds": args.duration})
+    out = args.file or "theia-profile.tar.gz"
+    n = _poll_and_download(args.manager_addr, path,
+                           args.duration + 120, out, "profile")
+    print(f"XLA profile written to {out} ({n} bytes); "
+          f"view with TensorBoard/xprof")
 
 
 def version(args) -> None:
@@ -730,6 +758,13 @@ def build_parser() -> argparse.ArgumentParser:
     sb = sub.add_parser("supportbundle")
     sb.add_argument("-f", "--file", default="")
     sb.set_defaults(fn=supportbundle)
+
+    prof = sub.add_parser("profile",
+                          help="capture an XLA profiler trace from "
+                               "the manager")
+    prof.add_argument("-d", "--duration", type=float, default=3.0)
+    prof.add_argument("-f", "--file", default="")
+    prof.set_defaults(fn=profile)
 
     ver = sub.add_parser("version")
     ver.set_defaults(fn=version)

@@ -229,7 +229,8 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
     controller: JobController
     stats: StatsProvider
     bundles: SupportBundleManager
-    ingest = None   # IngestManager
+    profiles = None   # ProfileManager
+    ingest = None     # IngestManager
     auth_token: Optional[str] = None
     quiet = True
     # Socket timeout (StreamRequestHandler honors it): a client that
@@ -472,22 +473,32 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
         self._send_json(doc)
 
     def _get_system(self, parts) -> None:
-        # Bundles carry logs/stats/job specs — exfiltration surface, so
-        # even their GETs require the token (reference bundles sit
-        # behind the aggregated apiserver's delegated authn).
+        # Bundles/profiles carry logs/stats/traces — exfiltration
+        # surface, so even their GETs require the token (reference
+        # bundles sit behind the aggregated apiserver's delegated
+        # authn).
         self._require_auth()
+
+        def stream(data: Optional[bytes], what: str) -> None:
+            if data is None:
+                raise KeyError(f"{what} not collected")
+            self.send_response(200)
+            self.send_header("Content-Type", "application/gzip")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
         if len(parts) >= 4 and parts[3] == "supportbundles":
             if len(parts) == 6 and parts[5] == "download":
-                data = self.bundles.data()
-                if data is None:
-                    raise KeyError("bundle not collected")
-                self.send_response(200)
-                self.send_header("Content-Type", "application/gzip")
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
+                stream(self.bundles.data(), "bundle")
                 return
             self._send_json(self.bundles.to_api())
+            return
+        if len(parts) >= 4 and parts[3] == "profiles":
+            if len(parts) == 6 and parts[5] == "download":
+                stream(self.profiles.data(), "profile")
+                return
+            self._send_json(self.profiles.to_api())
             return
         raise KeyError(self.path)
 
@@ -513,6 +524,12 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
         if self.path.startswith(GROUP_SYSTEM) and len(parts) >= 4 \
                 and parts[3] == "supportbundles":
             self._send_json(self.bundles.create(), 201)
+            return
+        if self.path.startswith(GROUP_SYSTEM) and len(parts) >= 4 \
+                and parts[3] == "profiles":
+            body = self._read_body()
+            self._send_json(self.profiles.create(
+                float(body.get("durationSeconds", 3.0) or 3.0)), 201)
             return
         raise KeyError(self.path)
 
@@ -597,6 +614,8 @@ class TheiaManagerServer:
         self.stats = StatsProvider(db, capacity_bytes=capacity_bytes)
         self.bundles = SupportBundleManager(self.controller, self.stats,
                                             ingest=self.ingest)
+        from .profiling import ProfileManager
+        self.profiles = ProfileManager()
         self.auth_token = resolve_auth_token(auth_token,
                                              auth_token_file)
 
@@ -604,6 +623,7 @@ class TheiaManagerServer:
             "controller": self.controller,
             "stats": self.stats,
             "bundles": self.bundles,
+            "profiles": self.profiles,
             "ingest": self.ingest,
             "auth_token": self.auth_token,
         })

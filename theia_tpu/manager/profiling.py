@@ -1,0 +1,111 @@
+"""On-demand XLA profiler capture over the system API.
+
+SURVEY §5/§7.7: the reference's only runtime introspection is
+scraping the Spark UI REST and ClickHouse system tables
+(pkg/apiserver/utils/stats/clickhouse_stats.go:92-117 dumps
+system.stack_trace); it has no accelerator profiler at all. Here the
+manager can capture a real XLA profile of whatever the engine is
+doing — device kernels, host callbacks, transfers — and hand back the
+trace directory as a tar.gz that loads straight into TensorBoard /
+Perfetto / xprof.
+
+    POST /apis/system.theia.antrea.io/v1alpha1/profiles
+        body: {"durationSeconds": N}   (default 3, capped)
+    GET  .../profiles                  → {"status": ..., "size": ...}
+    GET  .../profiles/theia-manager/download → tar.gz
+
+One capture at a time (the profiler cannot nest); bearer-token
+protected with the rest of the system group.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import shutil
+import tarfile
+import tempfile
+import threading
+import time
+from typing import Dict, Optional
+
+from ..utils import get_logger
+
+logger = get_logger("profiling")
+
+MAX_DURATION_SECONDS = 60.0
+
+
+class ProfileManager:
+    """Async single-flight XLA trace collection."""
+
+    def __init__(self) -> None:
+        self.status = "none"
+        self.duration: float = 0.0
+        self._data: Optional[bytes] = None
+        self._error = ""
+        self._lock = threading.Lock()
+
+    def create(self, duration_seconds: float = 3.0) -> Dict[str, object]:
+        duration = min(max(float(duration_seconds), 0.1),
+                       MAX_DURATION_SECONDS)
+        with self._lock:
+            # decide under the lock, respond after releasing it —
+            # to_api() re-acquires and the lock is not reentrant
+            already = self.status == "collecting"
+            if not already:
+                self.status = "collecting"
+                self.duration = duration
+                self._error = ""
+                self._data = None   # never serve the previous trace
+                                    # as if it were this capture
+        if not already:
+            threading.Thread(target=self._collect, args=(duration,),
+                             daemon=True).start()
+        return self.to_api()
+
+    def _collect(self, duration: float) -> None:
+        import jax
+
+        tmpdir = tempfile.mkdtemp(prefix="theia-xprof-")
+        try:
+            jax.profiler.start_trace(tmpdir)
+            try:
+                time.sleep(duration)
+            finally:
+                jax.profiler.stop_trace()
+            buf = io.BytesIO()
+            with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+                for root, _dirs, files in os.walk(tmpdir):
+                    for f in files:
+                        full = os.path.join(root, f)
+                        tar.add(full,
+                                arcname=os.path.relpath(full, tmpdir))
+            with self._lock:
+                self._data = buf.getvalue()
+                self.status = "collected"
+            logger.v(1).info("profile captured: %.1fs, %d bytes",
+                             duration, len(self._data))
+        except Exception as e:
+            with self._lock:
+                self.status = "failed"
+                self._error = f"{type(e).__name__}: {e}"
+            logger.error("profile capture failed: %s", self._error)
+        finally:
+            shutil.rmtree(tmpdir, ignore_errors=True)
+
+    def to_api(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "kind": "Profile",
+                "apiVersion": "system.theia.antrea.io/v1alpha1",
+                "metadata": {"name": "theia-manager"},
+                "status": self.status,
+                "durationSeconds": self.duration,
+                "size": len(self._data) if self._data else 0,
+                "errorMsg": self._error,
+            }
+
+    def data(self) -> Optional[bytes]:
+        with self._lock:
+            return self._data
