@@ -1,0 +1,82 @@
+"""CLI port-forward path (reference pkg/theia/portforwarder): the CLI
+tunnels to the in-cluster manager via `kubectl port-forward`,
+exercised here with a fake kubectl that fronts a real manager."""
+
+import stat
+import time
+
+import pytest
+
+from theia_tpu.cli.__main__ import main as cli_main
+from theia_tpu.cli.portforward import PortForwarder, PortForwardError
+from theia_tpu.data.synth import SynthConfig, generate_flows
+from theia_tpu.manager import TheiaManagerServer
+from theia_tpu.store import FlowDatabase
+
+
+def _fake_kubectl(tmp_path, port: int, lines=None, rc=0):
+    """A kubectl stand-in: prints the port-forward banner for `port`
+    then stays alive (like the real forwarder does)."""
+    script = tmp_path / "kubectl"
+    body = lines if lines is not None else [
+        f"Forwarding from 127.0.0.1:{port} -> 11347",
+        f"Forwarding from [::1]:{port} -> 11347",
+    ]
+    script.write_text(
+        "#!/bin/sh\n"
+        + "".join(f"echo '{line}'\n" for line in body)
+        + (f"exit {rc}\n" if rc else "sleep 600\n"))
+    script.chmod(script.stat().st_mode | stat.S_IEXEC)
+    return str(script)
+
+
+@pytest.fixture()
+def server():
+    db = FlowDatabase()
+    db.insert_flows(generate_flows(SynthConfig(
+        n_series=6, points_per_series=10, seed=5)))
+    srv = TheiaManagerServer(db, port=0)
+    srv.start_background()
+    yield srv
+    srv.shutdown()
+
+
+def test_port_forwarder_parses_port_and_stops(server, tmp_path):
+    kc = _fake_kubectl(tmp_path, server.port)
+    fw = PortForwarder("flow-visibility", kubectl=kc)
+    try:
+        assert fw.start() == server.port
+        assert fw._proc.poll() is None   # forwarder held open
+    finally:
+        fw.stop()
+    assert fw._proc is None
+
+
+def test_cli_use_port_forward_end_to_end(server, tmp_path, capsys):
+    kc = _fake_kubectl(tmp_path, server.port)
+    cli_main(["--use-port-forward", "--kubectl", kc,
+              "tad", "run", "--algo", "EWMA", "--wait"])
+    out = capsys.readouterr().out
+    assert "Successfully started" in out
+    # the forwarder child was torn down with the command
+    import subprocess
+    time.sleep(0.2)
+    left = subprocess.run(["pgrep", "-f", kc], capture_output=True,
+                          text=True).stdout.strip()
+    assert not left
+
+
+def test_missing_kubectl_is_a_clean_error():
+    fw = PortForwarder("ns", kubectl="/nonexistent/kubectl")
+    with pytest.raises(PortForwardError, match="PATH"):
+        fw.start()
+
+
+def test_kubectl_failure_reports_output(tmp_path):
+    kc = _fake_kubectl(tmp_path, 0,
+                       lines=["error: unable to forward"], rc=1)
+    fw = PortForwarder("ns", kubectl=kc)
+    # the operator sees kubectl's own words, not just "did not come up"
+    with pytest.raises(PortForwardError,
+                       match="unable to forward"):
+        fw.start()

@@ -589,6 +589,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--token-file", default="",
                    help="read the API bearer token from this file "
                         "(e.g. the manager's --auth-token-file)")
+    p.add_argument("--use-port-forward", action="store_true",
+                   help="tunnel to the in-cluster manager Service via "
+                        "`kubectl port-forward` (reference CLI "
+                        "default; needs a kubeconfig)")
+    p.add_argument("--namespace", default="flow-visibility",
+                   help="manager namespace for --use-port-forward")
+    p.add_argument("--service", default="theia-manager",
+                   help="manager Service for --use-port-forward")
+    p.add_argument("--kubectl", default="kubectl",
+                   help="kubectl binary for --use-port-forward")
     p.add_argument("-v", "--verbosity", type=int, default=0,
                    help="log verbosity (klog-style)")
     sub = p.add_subparsers(dest="command", required=True)
@@ -784,6 +794,16 @@ def main(argv=None) -> None:
         except OSError as e:
             raise APIError(
                 f"error: cannot read token file {token_file}: {e}")
+    forwarder = None
+    if getattr(args, "use_port_forward", False):
+        from .portforward import PortForwarder
+        forwarder = PortForwarder(args.namespace, args.service,
+                                  kubectl=args.kubectl)
+        local = forwarder.start()
+        # a --ca-cert means the in-cluster manager serves TLS; the
+        # tunnel carries the TLS bytes verbatim
+        scheme = "https" if _CA_CERT else "http"
+        args.manager_addr = f"{scheme}://127.0.0.1:{local}"
     from ..utils import set_verbosity
     set_verbosity(getattr(args, "verbosity", 0))
     try:
@@ -795,6 +815,9 @@ def main(argv=None) -> None:
         except Exception:
             pass
         raise SystemExit(0)
+    finally:
+        if forwarder is not None:
+            forwarder.stop()
 
 
 if __name__ == "__main__":

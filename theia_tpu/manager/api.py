@@ -43,6 +43,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 
+from .collect import AsyncCollector
 from .jobs import (
     KIND_DD,
     KIND_FPM,
@@ -109,7 +110,7 @@ def record_to_api(record: JobRecord, controller: JobController,
     return doc
 
 
-class SupportBundleManager:
+class SupportBundleManager(AsyncCollector):
     """Async support-bundle collection (reference supportBundleREST:
     Create spawns a collect goroutine, status polls, then download —
     rest.go:115-255,425). Contents mirror the reference ManagerDumper's
@@ -117,111 +118,80 @@ class SupportBundleManager:
     + per shard), device inventory, manager + runner logs, job records
     with progress, and recent alerts."""
 
+    kind = "SupportBundle"
+
     def __init__(self, controller: JobController,
                  stats: StatsProvider, ingest=None) -> None:
+        super().__init__()
         self.controller = controller
         self.stats = stats
         self.ingest = ingest
-        self.status = "none"
-        self._data: Optional[bytes] = None
-        self._lock = threading.Lock()
 
-    def create(self) -> Dict[str, object]:
-        with self._lock:
-            if self.status == "collecting":
-                return self.to_api()
-            self.status = "collecting"
-        threading.Thread(target=self._collect, daemon=True).start()
-        return self.to_api()
-
-    def _collect(self) -> None:
+    def _collect(self) -> bytes:
         buf = io.BytesIO()
-        try:
-            with tarfile.open(fileobj=buf, mode="w:gz") as tar:
-                def add(name: str, payload: str) -> None:
-                    raw = payload.encode()
-                    info = tarfile.TarInfo(name)
-                    info.size = len(raw)
-                    info.mtime = int(time.time())
-                    tar.addfile(info, io.BytesIO(raw))
+        with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+            def add(name: str, payload: str) -> None:
+                raw = payload.encode()
+                info = tarfile.TarInfo(name)
+                info.size = len(raw)
+                info.mtime = int(time.time())
+                tar.addfile(info, io.BytesIO(raw))
 
-                add("stats/diskInfo.json",
-                    json.dumps(self.stats.disk_infos(), indent=2))
-                add("stats/tableInfo.json",
-                    json.dumps(self.stats.table_infos(), indent=2))
-                add("stats/insertRate.json",
-                    json.dumps(self.stats.insert_rates(), indent=2))
-                add("stats/stackTraces.json",
-                    json.dumps(self.stats.stack_traces(), indent=2))
-                try:
-                    # touches jax.devices(): collected best-effort so a
-                    # wedged accelerator can't block the whole bundle
-                    add("stats/deviceInfo.json",
-                        json.dumps(self.stats.device_infos(),
-                                   indent=2))
-                except Exception as e:
-                    add("stats/deviceInfo.json",
-                        json.dumps({"error": str(e)}))
-                # Per-shard store summary (sharded deployments): which
-                # shard holds what — the Distributed-table operator view.
-                db = self.controller.db
-                if hasattr(db, "shards"):
-                    add("store/shards.json", json.dumps([
-                        {"shard": i,
-                         "flows": len(s.flows),
-                         "flowBytes": s.flows.nbytes,
-                         **{name: len(t) for name, t
-                            in s.result_tables.items()}}
-                        for i, s in enumerate(db.shards)], indent=2))
-                add("jobs.json", json.dumps(
-                    [record_to_api(r, self.controller)
-                     for r in self.controller.list()], indent=2,
-                    default=str))
-                # Recent manager logs — the reference's ManagerDumper
-                # copies log files out of the component pods
-                # (pkg/support/dump.go:55-66); here the in-process ring
-                # buffer is the log source.
-                add("logs/theia-manager.log", dump_logs())
-                # Runner children's stderr tails (the Spark driver/
-                # executor pod-log class), one file per dispatched job.
-                for r in self.controller.list():
-                    if r.runner_log_tail:
-                        add(f"logs/runner-{r.name}.log",
-                            r.runner_log_tail)
-                if self.ingest is not None:
-                    from .ingest import MAX_ALERTS
-                    add("alerts.json", json.dumps(
-                        self.ingest.recent_alerts(MAX_ALERTS),
-                        indent=2, default=str))
-                from .. import __version__
-                from ..store.migration import CURRENT_SCHEMA_VERSION
-                add("version.json", json.dumps({
-                    "version": __version__,
-                    "schemaVersion": CURRENT_SCHEMA_VERSION,
-                    "dispatch": self.controller.dispatch,
-                }, indent=2))
-            with self._lock:
-                self._data = buf.getvalue()
-                self.status = "collected"
-        except Exception:
-            with self._lock:
-                self.status = "none"
-            raise
-
-    def to_api(self) -> Dict[str, object]:
-        with self._lock:
-            size = len(self._data) if self._data else 0
-            return {
-                "kind": "SupportBundle",
-                "apiVersion": "system.theia.antrea.io/v1alpha1",
-                "metadata": {"name": "theia-manager"},
-                "status": self.status,
-                "size": size,
-            }
-
-    def data(self) -> Optional[bytes]:
-        with self._lock:
-            return self._data
+            add("stats/diskInfo.json",
+                json.dumps(self.stats.disk_infos(), indent=2))
+            add("stats/tableInfo.json",
+                json.dumps(self.stats.table_infos(), indent=2))
+            add("stats/insertRate.json",
+                json.dumps(self.stats.insert_rates(), indent=2))
+            add("stats/stackTraces.json",
+                json.dumps(self.stats.stack_traces(), indent=2))
+            try:
+                # touches jax.devices(): collected best-effort so a
+                # wedged accelerator can't block the whole bundle
+                add("stats/deviceInfo.json",
+                    json.dumps(self.stats.device_infos(), indent=2))
+            except Exception as e:
+                add("stats/deviceInfo.json",
+                    json.dumps({"error": str(e)}))
+            # Per-shard store summary (sharded deployments): which
+            # shard holds what — the Distributed-table operator view.
+            db = self.controller.db
+            if hasattr(db, "shards"):
+                add("store/shards.json", json.dumps([
+                    {"shard": i,
+                     "flows": len(s.flows),
+                     "flowBytes": s.flows.nbytes,
+                     **{name: len(t) for name, t
+                        in s.result_tables.items()}}
+                    for i, s in enumerate(db.shards)], indent=2))
+            add("jobs.json", json.dumps(
+                [record_to_api(r, self.controller)
+                 for r in self.controller.list()], indent=2,
+                default=str))
+            # Recent manager logs — the reference's ManagerDumper
+            # copies log files out of the component pods
+            # (pkg/support/dump.go:55-66); here the in-process ring
+            # buffer is the log source.
+            add("logs/theia-manager.log", dump_logs())
+            # Runner children's stderr tails (the Spark driver/
+            # executor pod-log class), one file per dispatched job.
+            for r in self.controller.list():
+                if r.runner_log_tail:
+                    add(f"logs/runner-{r.name}.log",
+                        r.runner_log_tail)
+            if self.ingest is not None:
+                from .ingest import MAX_ALERTS
+                add("alerts.json", json.dumps(
+                    self.ingest.recent_alerts(MAX_ALERTS),
+                    indent=2, default=str))
+            from .. import __version__
+            from ..store.migration import CURRENT_SCHEMA_VERSION
+            add("version.json", json.dumps({
+                "version": __version__,
+                "schemaVersion": CURRENT_SCHEMA_VERSION,
+                "dispatch": self.controller.dispatch,
+            }, indent=2))
+        return buf.getvalue()
 
 
 class ManagerAPIHandler(BaseHTTPRequestHandler):

@@ -25,46 +25,35 @@ import os
 import shutil
 import tarfile
 import tempfile
-import threading
 import time
-from typing import Dict, Optional
+from typing import Dict
 
 from ..utils import get_logger
+from .collect import AsyncCollector
 
 logger = get_logger("profiling")
 
 MAX_DURATION_SECONDS = 60.0
 
 
-class ProfileManager:
+class ProfileManager(AsyncCollector):
     """Async single-flight XLA trace collection."""
 
+    kind = "Profile"
+
     def __init__(self) -> None:
-        self.status = "none"
+        super().__init__()
         self.duration: float = 0.0
-        self._data: Optional[bytes] = None
-        self._error = ""
-        self._lock = threading.Lock()
 
     def create(self, duration_seconds: float = 3.0) -> Dict[str, object]:
-        duration = min(max(float(duration_seconds), 0.1),
-                       MAX_DURATION_SECONDS)
-        with self._lock:
-            # decide under the lock, respond after releasing it —
-            # to_api() re-acquires and the lock is not reentrant
-            already = self.status == "collecting"
-            if not already:
-                self.status = "collecting"
-                self.duration = duration
-                self._error = ""
-                self._data = None   # never serve the previous trace
-                                    # as if it were this capture
-        if not already:
-            threading.Thread(target=self._collect, args=(duration,),
-                             daemon=True).start()
-        return self.to_api()
+        self.duration = min(max(float(duration_seconds), 0.1),
+                            MAX_DURATION_SECONDS)
+        return super().create(self.duration)
 
-    def _collect(self, duration: float) -> None:
+    def _extra_status(self) -> Dict[str, object]:
+        return {"durationSeconds": self.duration}
+
+    def _collect(self, duration: float) -> bytes:
         import jax
 
         tmpdir = tempfile.mkdtemp(prefix="theia-xprof-")
@@ -81,31 +70,7 @@ class ProfileManager:
                         full = os.path.join(root, f)
                         tar.add(full,
                                 arcname=os.path.relpath(full, tmpdir))
-            with self._lock:
-                self._data = buf.getvalue()
-                self.status = "collected"
-            logger.v(1).info("profile captured: %.1fs, %d bytes",
-                             duration, len(self._data))
-        except Exception as e:
-            with self._lock:
-                self.status = "failed"
-                self._error = f"{type(e).__name__}: {e}"
-            logger.error("profile capture failed: %s", self._error)
+            logger.v(1).info("profile captured: %.1fs", duration)
+            return buf.getvalue()
         finally:
             shutil.rmtree(tmpdir, ignore_errors=True)
-
-    def to_api(self) -> Dict[str, object]:
-        with self._lock:
-            return {
-                "kind": "Profile",
-                "apiVersion": "system.theia.antrea.io/v1alpha1",
-                "metadata": {"name": "theia-manager"},
-                "status": self.status,
-                "durationSeconds": self.duration,
-                "size": len(self._data) if self._data else 0,
-                "errorMsg": self._error,
-            }
-
-    def data(self) -> Optional[bytes]:
-        with self._lock:
-            return self._data
