@@ -1,0 +1,197 @@
+"""Replicated store: write fan-out, read failover, resync, and the
+full consumer surface (jobs/manager) over replicas.
+
+Reference role: Replicated*MergeTree + ZooKeeper (`replicas` in
+build/charts/theia/values.yaml:121-183).
+"""
+
+import numpy as np
+import pytest
+
+from theia_tpu.data.synth import SynthConfig, generate_flows
+from theia_tpu.store import (
+    AllReplicasDownError,
+    FlowDatabase,
+    ReplicatedFlowDatabase,
+    ShardedFlowDatabase,
+)
+
+
+def _batch(seed, n=6, t=10):
+    return generate_flows(SynthConfig(n_series=n, points_per_series=t,
+                                      seed=seed))
+
+
+def test_writes_mirror_to_every_replica():
+    db = ReplicatedFlowDatabase(replicas=3)
+    n = db.insert_flows(_batch(1))
+    assert n == 60
+    for r in db.replicas:
+        assert len(r.flows) == 60
+        assert len(r.views["flows_pod_view"]) > 0
+
+
+def test_read_failover_and_resync():
+    db = ReplicatedFlowDatabase(replicas=2)
+    db.insert_flows(_batch(2))
+    before = len(db.flows)
+
+    db.set_replica_down(0)
+    # reads keep serving from replica 1
+    assert len(db.flows) == before
+    # writes during the outage land only on live replicas
+    db.insert_flows(_batch(3))
+    assert len(db.replicas[1].flows) == before + 60
+    assert len(db.replicas[0].flows) == before   # stale
+
+    # resync on the way back up: replica 0 catches up wholesale
+    db.set_replica_up(0)
+    assert len(db.replicas[0].flows) == before + 60
+    a = db.replicas[0].flows.scan()
+    b = db.replicas[1].flows.scan()
+    assert sorted(a.strings("sourceIP")) == sorted(b.strings("sourceIP"))
+    # views rebuilt on the resynced copy
+    assert len(db.replicas[0].views["flows_pod_view"]) == \
+        len(db.replicas[1].views["flows_pod_view"])
+
+
+def test_all_replicas_down_raises():
+    db = ReplicatedFlowDatabase(replicas=2)
+    db.set_replica_down(0)
+    db.set_replica_down(1)
+    with pytest.raises(AllReplicasDownError):
+        db.insert_flows(_batch(4))
+
+
+def test_ttl_and_retention_fan_out():
+    db = ReplicatedFlowDatabase(
+        replicas=2,
+        factory=lambda: FlowDatabase(ttl_seconds=100))
+    t0 = 1_700_000_000
+    batch = _batch(5)
+    batch.columns["timeInserted"] = np.full(len(batch), t0, np.int64)
+    db.insert_flows(batch, now=t0)
+    db.evict_ttl(t0 + 500)
+    for r in db.replicas:
+        assert len(r.flows) == 0
+
+
+def test_result_tables_replicate_and_value_delete():
+    db = ReplicatedFlowDatabase(replicas=2)
+    db.tadetector.insert_rows([{"id": "j1", "anomaly": "true"},
+                               {"id": "j2", "anomaly": "true"}])
+    for r in db.replicas:
+        assert len(r.tadetector) == 2
+    db.tadetector.delete_ids(["j1"])
+    for r in db.replicas:
+        assert set(r.tadetector.scan().strings("id")) == {"j2"}
+
+
+def test_replicated_over_sharded_composes():
+    db = ReplicatedFlowDatabase(
+        replicas=2,
+        factory=lambda: ShardedFlowDatabase(n_shards=2))
+    db.insert_flows(_batch(6))
+    # replicas route rows to shards independently (different physical
+    # order) but hold the same logical contents
+    a = db.replicas[0].flows.scan()
+    b = db.replicas[1].flows.scan()
+    assert len(a) == len(b) == 60
+    assert sorted(zip(a.strings("sourceIP"),
+                      np.asarray(a["octetDeltaCount"]).tolist())) == \
+        sorted(zip(b.strings("sourceIP"),
+                   np.asarray(b["octetDeltaCount"]).tolist()))
+
+
+def test_resync_does_not_lose_concurrent_writes():
+    """Writes racing set_replica_up must never fall in the gap between
+    the resync copy and the up-mark (they would be permanently missing
+    from the recovered replica)."""
+    import threading
+
+    db = ReplicatedFlowDatabase(replicas=2)
+    db.insert_flows(_batch(20, n=2, t=4))
+    db.set_replica_down(0)
+
+    def writer():
+        for i in range(20):
+            db.insert_flows(_batch(100 + i, n=2, t=2))
+
+    t = threading.Thread(target=writer)
+    t.start()
+    db.set_replica_up(0)
+    t.join(timeout=120)
+    assert not t.is_alive()
+    a, b = (r.flows.scan() for r in db.replicas)
+    assert len(a) == len(b)
+    assert sorted(zip(a.strings("sourceIP"),
+                      np.asarray(a["flowEndSeconds"]).tolist())) == \
+        sorted(zip(b.strings("sourceIP"),
+                   np.asarray(b["flowEndSeconds"]).tolist()))
+
+
+def test_positional_delete_refused_on_replicated_tables():
+    db = ReplicatedFlowDatabase(replicas=2)
+    db.tadetector.insert_rows([{"id": "x", "anomaly": "true"}])
+    with pytest.raises(NotImplementedError, match="delete_ids"):
+        db.tadetector.delete_where(np.ones(1, bool))
+
+
+def test_load_defers_ttl_until_rows_are_back(tmp_path):
+    """Re-inserting a snapshot must not let each replica's TTL evict
+    persisted rows at an arbitrary boundary (the discipline the
+    single-node and sharded load paths already follow)."""
+    t0 = 1_700_000_000
+    db = ReplicatedFlowDatabase(replicas=2)
+    batch = _batch(21)
+    # rows spanning far more than the TTL window
+    batch.columns["timeInserted"] = np.linspace(
+        t0, t0 + 10_000, len(batch)).astype(np.int64)
+    db.insert_flows(batch)
+    path = str(tmp_path / "r.npz")
+    db.save(path)
+    back = ReplicatedFlowDatabase.load(path, replicas=2,
+                                       ttl_seconds=100)
+    for r in back.replicas:
+        assert len(r.flows) == 60   # nothing evicted during load
+        assert r.ttl_seconds == 100  # TTL live again afterwards
+
+
+def test_manager_runs_jobs_over_replicated_store():
+    from theia_tpu.manager import TheiaManagerServer
+    from theia_tpu.manager.jobs import KIND_TAD
+
+    db = ReplicatedFlowDatabase(replicas=2)
+    db.insert_flows(generate_flows(SynthConfig(
+        n_series=8, points_per_series=16, anomaly_fraction=0.5,
+        anomaly_magnitude=50.0, seed=7)))
+    srv = TheiaManagerServer(db, port=0, workers=1)
+    try:
+        rec = srv.controller.create(KIND_TAD, {"jobType": "EWMA"})
+        assert srv.controller.wait_all()
+        assert rec.state == "COMPLETED", rec.error_msg
+        stats = srv.controller.tad_stats(rec.name)
+        assert stats
+        # result rows landed on BOTH replicas
+        for r in db.replicas:
+            assert len(r.tadetector) == len(stats)
+        # job delete GCs results from both
+        srv.controller.delete(rec.name)
+        for r in db.replicas:
+            assert len(r.tadetector) == 0
+        # failover mid-flight: stats still served
+        db.set_replica_down(0)
+        assert srv.stats.table_infos()
+    finally:
+        srv.shutdown()
+
+
+def test_save_load_roundtrip(tmp_path):
+    db = ReplicatedFlowDatabase(replicas=2)
+    db.insert_flows(_batch(8))
+    path = str(tmp_path / "r.npz")
+    db.save(path)   # active replica's snapshot
+    back = ReplicatedFlowDatabase.load(path, replicas=2)
+    assert len(back.flows) == 60
+    for r in back.replicas:
+        assert len(r.flows) == 60

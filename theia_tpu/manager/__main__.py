@@ -57,6 +57,11 @@ def main(argv=None) -> None:
                    help="flow store shards (the reference's ClickHouse "
                         "`shards` Helm value; >1 uses the Distributed-"
                         "table equivalent)")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="live copies of the logical store (the "
+                        "reference's `replicas` Helm value / "
+                        "ReplicatedMergeTree role): writes fan to all, "
+                        "reads fail over; composes with --shards")
     p.add_argument("--tls-cert-dir", default=None,
                    help="enable TLS; certs generated/loaded here")
     p.add_argument("--tls-cert", default=None)
@@ -116,7 +121,22 @@ def main(argv=None) -> None:
     if ttl is None:
         ttl = env_int("THEIA_TTL_SECONDS", 0) or None
 
-    if args.shards > 1:
+    if args.replicas > 1:
+        from ..store import ReplicatedFlowDatabase
+
+        def _factory():
+            if args.shards > 1:
+                return ShardedFlowDatabase(n_shards=args.shards,
+                                           ttl_seconds=ttl)
+            return FlowDatabase(ttl_seconds=ttl)
+
+        if args.db and os.path.exists(args.db):
+            db = ReplicatedFlowDatabase.load(
+                args.db, replicas=args.replicas, factory=_factory)
+        else:
+            db = ReplicatedFlowDatabase(replicas=args.replicas,
+                                        factory=_factory)
+    elif args.shards > 1:
         if args.db and os.path.exists(args.db):
             db = ShardedFlowDatabase.load(args.db,
                                           n_shards=args.shards,

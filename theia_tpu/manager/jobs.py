@@ -217,21 +217,14 @@ class JobController:
             live = {r.job_id for r in self._records.values()}
         removed = 0
         for table in self.db.result_tables.values():
-            data = table.scan()
-            if not len(data):
-                continue
-            ids = data.strings("id")
-            stale = ~np.isin(ids, list(live)) if live else np.ones(
-                len(ids), bool)
-            if stale.any():
-                removed += table.delete_where(stale)
+            # value-based delete: identical logical rows can sit in
+            # different physical orders across shards/replicas, so a
+            # positional mask would be wrong there
+            removed += table.delete_ids(live, invert=True)
         return removed
 
     def _delete_results(self, kind: str, job_id: str) -> None:
-        table = self.db.result_tables[_RESULT_TABLE[kind]]
-        data = table.scan()
-        if len(data):
-            table.delete_where(data.strings("id") == job_id)
+        self.db.result_tables[_RESULT_TABLE[kind]].delete_ids([job_id])
 
     # -- result retrieval ------------------------------------------------
 

@@ -2,13 +2,15 @@
 
 from .checkpoint import Checkpointer
 from .flow_store import FlowDatabase, RetentionMonitor, Table
+from .replicated import AllReplicasDownError, ReplicatedFlowDatabase
 from .sharded import (DistributedTable, DistributedView,
                       ShardedFlowDatabase)
 from .views import (MATERIALIZED_VIEWS, ViewSpec, ViewTable, group_reduce,
                     group_sum)
 
 __all__ = [
-    "Checkpointer", "FlowDatabase", "RetentionMonitor", "Table",
+    "AllReplicasDownError", "Checkpointer", "FlowDatabase",
+    "ReplicatedFlowDatabase", "RetentionMonitor", "Table",
     "DistributedTable", "DistributedView", "ShardedFlowDatabase",
     "MATERIALIZED_VIEWS", "ViewSpec", "ViewTable", "group_reduce", "group_sum",
 ]

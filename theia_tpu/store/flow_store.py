@@ -198,6 +198,22 @@ class Table:
         self.generation += 1
         return int(mask.sum())
 
+    def delete_ids(self, ids, column: str = "id",
+                   invert: bool = False) -> int:
+        """Value-based delete: rows whose `column` decodes into `ids`
+        (or does NOT, with invert=True). Safe wherever a positional
+        mask is not — replicas and shards hold the same logical rows
+        in different physical orders. Computed under the table lock."""
+        with self._lock:
+            if not self._batches:
+                return 0
+            data = (self._batches[0] if len(self._batches) == 1
+                    else ColumnarBatch.concat(self._batches))
+            mask = np.isin(data.strings(column), list(ids))
+            if invert:
+                mask = ~mask
+            return self._delete_where_locked(mask)
+
     def delete_older_than(self, boundary: int,
                           column: str = "timeInserted") -> int:
         """Atomic `column < boundary` delete (mask computed under the

@@ -1,0 +1,261 @@
+"""Replicated flow database — the high-availability tier.
+
+Re-provides the role of the reference's Replicated*MergeTree +
+ZooKeeper topology (build/charts/theia/values.yaml:121-183: `replicas`
+per shard, ZooKeeper coordinating replica queues): R live copies of
+the logical store, writes fanned to every live replica, reads served
+from the lowest-index live one, immediate failover when a replica is
+marked down, and catch-up-by-copy when one comes back (the in-memory
+analogue of a ClickHouse replica replaying its queue from a peer).
+
+Composition order matters: replication wraps the WHOLE logical store
+(optionally a ShardedFlowDatabase), so `--shards N --replicas R` is N
+shards × R replicas — the same grid the reference's operator CRD
+renders.
+
+Consumer surface: identical to FlowDatabase. Read paths delegate to
+the active replica via __getattr__; write paths (insert, TTL,
+retention, result-table mutation) are explicit fan-out overrides.
+Result tables are wrapped so analytics jobs and the controller's GC
+mutate every live replica; their deletes are value-based
+(Table.delete_ids), because replicas route rows to different physical
+orders and a positional mask would corrupt them.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+from .flow_store import FlowDatabase
+
+#: result-table write/read methods the replica proxy forwards
+_TABLE_WRITES = ("insert", "insert_rows", "delete_ids",
+                 "delete_older_than", "truncate")
+
+
+class AllReplicasDownError(Exception):
+    """Every replica is marked down — no copy can serve."""
+
+
+def _suspend_ttl(replica):
+    """Disable TTL on a replica (and its shards, if sharded) for a
+    bulk re-insert; returns the saved value for _restore_ttl."""
+    saved = replica.ttl_seconds
+    replica.ttl_seconds = None
+    for shard in getattr(replica, "shards", ()):
+        shard.ttl_seconds = None
+    return saved
+
+
+def _restore_ttl(replica, saved) -> None:
+    replica.ttl_seconds = saved
+    for shard in getattr(replica, "shards", ()):
+        shard.ttl_seconds = saved
+
+
+class _ReplicatedTable:
+    """One result table across replicas: reads from the active copy,
+    writes to every live copy."""
+
+    def __init__(self, db: "ReplicatedFlowDatabase", name: str) -> None:
+        self._db = db
+        self._table_name = name
+
+    def _active(self):
+        return self._db.active.result_tables[self._table_name]
+
+    # -- reads ------------------------------------------------------------
+
+    @property
+    def name(self):
+        return self._table_name
+
+    @property
+    def schema(self):
+        return self._active().schema
+
+    @property
+    def dicts(self):
+        return self._active().dicts
+
+    @property
+    def nbytes(self):
+        return self._active().nbytes
+
+    @property
+    def generation(self):
+        return self._active().generation
+
+    def __len__(self):
+        return len(self._active())
+
+    def scan(self):
+        return self._active().scan()
+
+    def select(self, *a, **kw):
+        return self._active().select(*a, **kw)
+
+    def min_value(self, *a, **kw):
+        return self._active().min_value(*a, **kw)
+
+    # -- writes (fan-out) --------------------------------------------------
+
+    def delete_where(self, mask):
+        raise NotImplementedError(
+            "positional delete_where is unsafe across replicas (each "
+            "copy holds the same logical rows in a different physical "
+            "order); use the value-based delete_ids")
+
+    def __getattr__(self, name):
+        if name in _TABLE_WRITES:
+            def fan(*a, **kw):
+                out = 0
+                with self._db._write_lock:
+                    for r in self._db.live():
+                        out = getattr(
+                            r.result_tables[self._table_name],
+                            name)(*a, **kw)
+                return out
+            return fan
+        return getattr(self._active(), name)
+
+
+class ReplicatedFlowDatabase:
+    """R live copies of the logical store behind one FlowDatabase
+    surface."""
+
+    def __init__(self, replicas: int = 2,
+                 factory: Optional[Callable[[], object]] = None,
+                 ttl_seconds: Optional[int] = None) -> None:
+        if replicas < 1:
+            raise ValueError("replicas must be >= 1")
+        make = factory or (
+            lambda: FlowDatabase(ttl_seconds=ttl_seconds))
+        self.replicas: List = [make() for _ in range(replicas)]
+        self._down: set = set()
+        self._lock = threading.Lock()
+        # Serializes fan-out writes against each other (deterministic
+        # per-replica apply order) and — critically — against resync:
+        # without it a write landing between the resync copy and the
+        # up-mark would be missing from the recovered replica forever.
+        self._write_lock = threading.Lock()
+        self.result_tables: Dict[str, _ReplicatedTable] = {
+            name: _ReplicatedTable(self, name)
+            for name in self.replicas[0].result_tables}
+        for name, proxy in self.result_tables.items():
+            setattr(self, name, proxy)
+
+    # -- replica membership ------------------------------------------------
+
+    def live(self) -> List:
+        with self._lock:
+            down = set(self._down)
+        out = [r for i, r in enumerate(self.replicas) if i not in down]
+        if not out:
+            raise AllReplicasDownError(
+                f"all {len(self.replicas)} replicas are down")
+        return out
+
+    @property
+    def active(self):
+        """Lowest-index live replica — the read servant."""
+        return self.live()[0]
+
+    def set_replica_down(self, index: int) -> None:
+        with self._lock:
+            self._down.add(index)
+
+    def set_replica_up(self, index: int, resync: bool = True) -> None:
+        """Bring a replica back; by default it catches up by copying
+        the active peer's state wholesale (the replica-queue replay
+        analogue — correct, if not incremental, at in-memory scale).
+        Holds the write lock across copy + up-mark, so no write can
+        slip between them and be lost on the recovered replica."""
+        with self._write_lock:
+            if resync:
+                peer = self.active
+                if self.replicas[index] is not peer:
+                    self._resync(self.replicas[index], peer)
+            with self._lock:
+                self._down.discard(index)
+
+    @staticmethod
+    def _resync(stale, peer) -> None:
+        stale.flows.truncate()
+        for view in stale.views.values():
+            view.truncate()
+        flows = peer.flows.scan()
+        if len(flows):
+            stale.insert_flows(flows)
+        for name, table in stale.result_tables.items():
+            table.truncate()
+            data = peer.result_tables[name].scan()
+            if len(data):
+                table.insert(data)
+
+    # -- writes (fan-out) --------------------------------------------------
+
+    def insert_flows(self, batch, now=None) -> int:
+        n = 0
+        with self._write_lock:
+            for r in self.live():
+                n = r.insert_flows(batch, now=now)
+        return n
+
+    def insert_flow_rows(self, rows, now=None) -> int:
+        n = 0
+        with self._write_lock:
+            for r in self.live():
+                n = r.insert_flow_rows(rows, now=now)
+        return n
+
+    def evict_ttl(self, now: int) -> int:
+        out = 0
+        with self._write_lock:
+            for r in self.live():
+                out = r.evict_ttl(now)
+        return out
+
+    def delete_flows_older_than(self, boundary: int) -> int:
+        out = 0
+        with self._write_lock:
+            for r in self.live():
+                out = r.delete_flows_older_than(boundary)
+        return out
+
+    # -- reads / passthrough ----------------------------------------------
+
+    def monitor(self, capacity_bytes: int, **kw):
+        from .flow_store import RetentionMonitor
+        return RetentionMonitor(self, capacity_bytes, **kw)
+
+    def __getattr__(self, name):
+        # flows / views / ttl_seconds / save / shards / ... — served by
+        # the active replica. (Direct writes through these bypass
+        # replication; the manager's write paths all go through the
+        # overrides above.)
+        return getattr(self.active, name)
+
+    @classmethod
+    def load(cls, path: str, replicas: int = 2,
+             ttl_seconds: Optional[int] = None,
+             **kw) -> "ReplicatedFlowDatabase":
+        """Load a snapshot into every replica (they start identical,
+        like freshly synced ClickHouse replicas). TTL is deferred
+        until every row is back in — the re-insert must not evict
+        persisted rows at an arbitrary boundary (same discipline as
+        FlowDatabase.load / ShardedFlowDatabase.load)."""
+        db = cls(replicas=replicas, ttl_seconds=ttl_seconds, **kw)
+        saved_ttls = [_suspend_ttl(r) for r in db.replicas]
+        single = FlowDatabase.load(path, build_views=False)
+        flows = single.flows.scan()
+        if len(flows):
+            db.insert_flows(flows)
+        for name, table in single.result_tables.items():
+            data = table.scan()
+            if len(data):
+                db.result_tables[name].insert(data)
+        for r, ttl in zip(db.replicas, saved_ttls):
+            _restore_ttl(r, ttl)
+        return db

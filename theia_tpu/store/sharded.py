@@ -124,6 +124,11 @@ class DistributedTable:
                 deleted += t._delete_where_locked(part)
             return deleted
 
+    def delete_ids(self, ids, column: str = "id",
+                   invert: bool = False) -> int:
+        return sum(t.delete_ids(ids, column=column, invert=invert)
+                   for t in self.tables)
+
     def delete_older_than(self, boundary: int,
                           column: str = "timeInserted") -> int:
         return sum(t.delete_older_than(boundary, column)
