@@ -378,21 +378,33 @@ def run_benchmarks() -> dict:
         print(f"e2e bench skipped: {e}", file=sys.stderr)
 
     try:
+        import contextlib
+
         from theia_tpu.analytics.streaming import StreamingDetector
-        det = StreamingDetector(capacity=1024)
-        S, T = cfg.n_series, cfg.points_per_series
-        idx = np.arange(len(batch)).reshape(S, T)
-        lat = []
-        for t in range(min(T, 40)):
-            micro = batch.take(idx[:, t])
-            t9 = time.perf_counter()
-            det.ingest(micro)
-            lat.append(time.perf_counter() - t9)
+        try:
+            # Host cpu backend, same rationale as the e2e leg: the
+            # detector state is host-resident in production; under
+            # axon the remote tunnel would dominate the p50.
+            cpu_ctx2 = jax.default_device(jax.devices("cpu")[0])
+        except Exception:
+            cpu_ctx2 = contextlib.nullcontext()
+        with cpu_ctx2:
+            det = StreamingDetector(capacity=1024)
+            S, T = cfg.n_series, cfg.points_per_series
+            idx = np.arange(len(batch)).reshape(S, T)
+            lat = []
+            for t in range(min(T, 40)):
+                micro = batch.take(idx[:, t])
+                t9 = time.perf_counter()
+                det.ingest(micro)
+                lat.append(time.perf_counter() - t9)
         p50 = sorted(lat)[len(lat) // 2]
         print(f"streaming micro-batch p50: {p50 * 1e3:.2f} ms "
               f"({S} series/batch)", file=sys.stderr)
+        result_extra_p50 = p50
     except Exception as e:
         print(f"streaming bench skipped: {e}", file=sys.stderr)
+        result_extra_p50 = None
 
     result = {
         "metric": "tad_ewma_scoring_records_per_sec",
@@ -409,6 +421,9 @@ def run_benchmarks() -> dict:
         result["e2e_multi_stream_rows_per_sec"] = e2e_scaling
         result["e2e_rows_per_sec_per_core"] = round(
             e2e_rate / (os.cpu_count() or 1))
+    if result_extra_p50 is not None:
+        result["streaming_alert_p50_ms"] = round(
+            result_extra_p50 * 1e3, 2)
     if dev.platform == "cpu":
         result["degraded"] = "cpu fallback (accelerator unavailable)"
     return result
