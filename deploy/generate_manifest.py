@@ -112,6 +112,70 @@ spec:
 """
 
 
+#: CRD kinds (group crd.theia.antrea.io, reference
+#: pkg/apis/crd/v1alpha1/types.go) — plural, singular, kind, short
+_CRD_KINDS = (
+    ("networkpolicyrecommendations", "networkpolicyrecommendation",
+     "NetworkPolicyRecommendation", "npr"),
+    ("throughputanomalydetectors", "throughputanomalydetector",
+     "ThroughputAnomalyDetector", "tad"),
+    ("trafficdropdetections", "trafficdropdetection",
+     "TrafficDropDetection", "tdd"),
+    ("flowpatternminings", "flowpatternmining",
+     "FlowPatternMining", "fpm"),
+    ("spatialanomalydetections", "spatialanomalydetection",
+     "SpatialAnomalyDetection", "sad"),
+)
+
+
+def _crds() -> list:
+    """CustomResourceDefinitions for the five job kinds: the
+    declarative API surface (`kubectl apply` a CR, the manager's
+    reconciler — theia_tpu/manager/reconciler.py — turns it into a
+    job). Spec schemas stay open (preserve-unknown-fields): the
+    manager validates, like the reference controllers do."""
+    docs = []
+    for plural, singular, kind, short in _CRD_KINDS:
+        docs.append(f"""\
+apiVersion: apiextensions.k8s.io/v1
+kind: CustomResourceDefinition
+metadata:
+  name: {plural}.crd.theia.antrea.io
+spec:
+  group: crd.theia.antrea.io
+  scope: Namespaced
+  names:
+    plural: {plural}
+    singular: {singular}
+    kind: {kind}
+    shortNames: ["{short}"]
+  versions:
+    - name: v1alpha1
+      served: true
+      storage: true
+      subresources:
+        status: {{}}
+      schema:
+        openAPIV3Schema:
+          type: object
+          properties:
+            spec:
+              type: object
+              x-kubernetes-preserve-unknown-fields: true
+            status:
+              type: object
+              x-kubernetes-preserve-unknown-fields: true
+      additionalPrinterColumns:
+        - name: State
+          type: string
+          jsonPath: .status.state
+        - name: Completed
+          type: integer
+          jsonPath: .status.completedStages
+""")
+    return docs
+
+
 def _rbac(namespace: str, auth: bool) -> list:
     """theia-cli access plumbing, mirroring the reference's
     theia-cli templates: a ServiceAccount an operator can `kubectl
@@ -168,7 +232,7 @@ def manifest(namespace: str, manager: bool, tls: bool,
              image: str, auth: bool = False, pvc: str = "",
              dispatch: str = "thread",
              checkpoint_interval: int = 60,
-             token: str = "") -> str:
+             token: str = "", crds: bool = False) -> str:
     docs = [f"""\
 apiVersion: v1
 kind: Namespace
@@ -177,6 +241,8 @@ metadata:
   labels:
     app: theia-tpu
 """]
+    if crds:
+        docs.extend(_crds())
     if manager:
         if auth:
             # Render-time random token (the self-signed-cert
@@ -245,6 +311,9 @@ def main(argv=None) -> None:
     p.add_argument("--auth", action="store_true",
                    help="bearer-token authn: Secret + manager env + "
                         "CLI read RBAC")
+    p.add_argument("--crds", action="store_true",
+                   help="include CustomResourceDefinitions for the "
+                        "five job kinds (declarative CR surface)")
     p.add_argument("--pvc", default="",
                    help="PersistentVolumeClaim size for /data (e.g. "
                         "16Gi); default emptyDir")
@@ -259,7 +328,8 @@ def main(argv=None) -> None:
         args.namespace, not args.no_manager, args.tls,
         args.capacity_bytes, args.ttl_seconds, args.image,
         auth=args.auth, pvc=args.pvc, dispatch=args.dispatch,
-        checkpoint_interval=args.checkpoint_interval))
+        checkpoint_interval=args.checkpoint_interval,
+        crds=args.crds))
 
 
 if __name__ == "__main__":

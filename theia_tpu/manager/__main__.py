@@ -75,6 +75,10 @@ def main(argv=None) -> None:
                    help="require the bearer token stored here; a fresh "
                         "random token is generated into the file if "
                         "absent (mode 0600)")
+    p.add_argument("--reconcile-dir", default=None,
+                   help="reconcile CR YAML documents in this directory "
+                        "into jobs (the CRD control-plane seam; status "
+                        "written back as <name>.status.yaml)")
     args = p.parse_args(argv)
 
     # Honor an explicit JAX_PLATFORMS before any backend initializes:
@@ -194,9 +198,20 @@ def main(argv=None) -> None:
         print(f"checkpointing {args.db} every "
               f"{args.checkpoint_interval:g}s", file=sys.stderr)
 
+    reconciler = None
+    if args.reconcile_dir:
+        from .reconciler import DeclarativeReconciler
+        reconciler = DeclarativeReconciler(server.controller,
+                                           args.reconcile_dir)
+        reconciler.start()
+        print(f"reconciling CRs in {args.reconcile_dir}",
+              file=sys.stderr)
+
     signal.signal(signal.SIGINT, stop)
     signal.signal(signal.SIGTERM, stop)
     server.serve_forever()
+    if reconciler:
+        reconciler.stop()
     # Drain in-flight jobs before persisting so their result rows make
     # it into the saved file.
     server.controller.wait_all(timeout=60)
