@@ -12,10 +12,9 @@ import threading
 import time
 
 import numpy as np
-import pytest
 
 from theia_tpu.data.synth import SynthConfig, generate_flows
-from theia_tpu.ingest import BlockEncoder, encode_tsv
+from theia_tpu.ingest import BlockEncoder
 from theia_tpu.manager.ingest import IngestManager
 from theia_tpu.schema import FLOW_SCHEMA, ColumnarBatch
 from theia_tpu.store import FlowDatabase

@@ -137,7 +137,7 @@ def test_support_bundle_v2_contents():
     device info, runner log tails, recent alerts, version stamp."""
     import time
 
-    from theia_tpu.manager.jobs import KIND_TAD, JobController
+    from theia_tpu.manager.jobs import KIND_TAD
     from theia_tpu.store import ShardedFlowDatabase
 
     db = ShardedFlowDatabase(n_shards=2)

@@ -3,7 +3,6 @@
 from __future__ import annotations
 
 import numpy as np
-import pytest
 
 from theia_tpu.analytics.npr_device import (
     device_distinct,

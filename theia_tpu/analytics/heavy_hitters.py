@@ -24,7 +24,7 @@ no string work on the hot path. Alerts carry decoded names.
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Tuple
+from typing import List, Tuple
 
 import jax
 import jax.numpy as jnp

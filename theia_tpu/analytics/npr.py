@@ -37,7 +37,6 @@ from .policy_gen import (
     KIND_ACNP,
     KIND_ANP,
     KIND_KNP,
-    PEER_DELIMITER,
     ROW_DELIMITER,
 )
 from .series import remove_meaningless_labels

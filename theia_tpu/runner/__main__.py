@@ -30,7 +30,6 @@ from __future__ import annotations
 import argparse
 import datetime
 import json
-import sys
 from typing import Optional
 
 from ..utils import AGG_FLOWS, TAD_ALGOS
