@@ -56,6 +56,7 @@ from .jobs import (
     JobRecord,
 )
 from .stats import StatsProvider
+from .. import __version__
 from ..utils import dump_logs, get_logger
 
 logger = get_logger("apiserver")
@@ -184,7 +185,6 @@ class SupportBundleManager(AsyncCollector):
                 add("alerts.json", json.dumps(
                     self.ingest.recent_alerts(MAX_ALERTS),
                     indent=2, default=str))
-            from .. import __version__
             from ..store.migration import CURRENT_SCHEMA_VERSION
             add("version.json", json.dumps({
                 "version": __version__,
@@ -195,7 +195,7 @@ class SupportBundleManager(AsyncCollector):
 
 
 class ManagerAPIHandler(BaseHTTPRequestHandler):
-    server_version = "theia-tpu-manager/0.3"
+    server_version = f"theia-tpu-manager/{__version__}"
     controller: JobController
     stats: StatsProvider
     bundles: SupportBundleManager
@@ -345,7 +345,6 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
             self._send_json({"status": "ok"})
             return
         if parts == ("version",):
-            from .. import __version__
             self._send_json({"version": __version__})
             return
         if self.path.startswith(GROUP_INTELLIGENCE):
