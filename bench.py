@@ -289,26 +289,40 @@ def run_benchmarks() -> dict:
                     HeavyHitterDetector
                 from theia_tpu.analytics.streaming import \
                     StreamingDetector
-                d2 = TsvDecoder()
-                db2 = FlowDatabase(ttl_seconds=12 * 3600)
-                hh2, sd2 = HeavyHitterDetector(), StreamingDetector()
-                warm = d2.decode_block(blocks[0])
-                db2.insert_flows(warm)
-                hh2.update(warm)
-                sd2.ingest(warm)
+                # Best-of-2 vs CPU steal: each pass rebuilds ALL state
+                # (same workload both times — replaying into a grown
+                # store / warmed detectors would measure a different
+                # pipeline), and the kept stage triple comes from ONE
+                # pass (independent per-stage minima could describe an
+                # execution that never happened and mis-name the cap).
                 t_dec = t_store = t_det = 0.0
-                for p in blocks[1:]:
-                    ta = time.perf_counter()
-                    b = d2.decode_block(p)
-                    tb = time.perf_counter()
-                    db2.insert_flows(b)
-                    tc = time.perf_counter()
-                    hh2.update(b)
-                    sd2.ingest(b)
-                    td = time.perf_counter()
-                    t_dec += tb - ta
-                    t_store += tc - tb
-                    t_det += td - tc
+                best_total = float("inf")
+                for _ in range(2):
+                    d2 = TsvDecoder()
+                    db2 = FlowDatabase(ttl_seconds=12 * 3600)
+                    hh2 = HeavyHitterDetector()
+                    sd2 = StreamingDetector()
+                    warm = d2.decode_block(blocks[0])
+                    db2.insert_flows(warm)
+                    hh2.update(warm)
+                    sd2.ingest(warm)
+                    s_dec = s_store = s_det = 0.0
+                    for p in blocks[1:]:
+                        ta = time.perf_counter()
+                        b = d2.decode_block(p)
+                        tb = time.perf_counter()
+                        db2.insert_flows(b)
+                        tc = time.perf_counter()
+                        hh2.update(b)
+                        sd2.ingest(b)
+                        td = time.perf_counter()
+                        s_dec += tb - ta
+                        s_store += tc - tb
+                        s_det += td - tc
+                    total = s_dec + s_store + s_det
+                    if total < best_total:
+                        best_total = total
+                        t_dec, t_store, t_det = s_dec, s_store, s_det
             e2e_rate = n_e2e / dt
             e2e_stages = {
                 "decode_rows_per_sec": round(n_e2e / t_dec),
