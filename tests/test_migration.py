@@ -71,6 +71,46 @@ def test_load_migrates_old_file(tmp_path):
                                   batch.strings("sourceIP"))
 
 
+def test_upgrade_v5_file_to_v6_and_run_new_jobs(tmp_path):
+    """The reference's TestUpgrade (version N-1 → N): a round-4-era v5
+    snapshot loads under today's schema, gains the v6 result tables,
+    and the NEW job kinds run against the upgraded store end to end."""
+    from theia_tpu.analytics import run_pattern_mining, run_spatial
+    from theia_tpu.schema import FLOW_SCHEMA, ColumnarBatch
+
+    db = FlowDatabase()
+    db.insert_flows(generate_flows(SynthConfig(
+        n_series=4, points_per_series=10, seed=31)))
+    # a one-off flow: guaranteed spatial noise in the upgraded store
+    db.insert_flows(ColumnarBatch.from_rows([{
+        "sourceIP": "203.0.113.50", "destinationIP": "198.51.100.9",
+        "destinationTransportPort": 9999, "octetDeltaCount": 77,
+        "packetDeltaCount": 1}], FLOW_SCHEMA, db.flows.dicts))
+    db.tadetector.insert_rows([{"id": "old-job", "anomaly": "true"}])
+    payload = _payload_from_db(db)
+    migrate(payload, target=5)   # simulate the previous release's file
+    assert not any(k.startswith("flowpatterns/") for k in payload)
+    old = str(tmp_path / "v5.npz")
+    np.savez_compressed(old, **payload)
+
+    db2 = FlowDatabase.load(old)
+    # prior-era data intact
+    assert len(db2.flows) == 41
+    assert set(db2.tadetector.scan().strings("id")) == {"old-job"}
+    # the v6 tables exist (empty) and the new kinds run on the store
+    assert len(db2.flowpatterns) == 0 and len(db2.spatialnoise) == 0
+    run_pattern_mining(db2, mesh=None)
+    run_spatial(db2, mesh=None)
+    assert len(db2.flowpatterns) > 0
+    assert "203.0.113.50" in set(
+        db2.spatialnoise.scan().strings("sourceIP"))
+    # and the upgraded store re-saves at the current version
+    new = str(tmp_path / "v6.npz")
+    db2.save(new)
+    with np.load(new, allow_pickle=True) as z:
+        assert int(z[VERSION_KEY]) == CURRENT_SCHEMA_VERSION
+
+
 def test_refuses_future_version():
     payload = {}
     force(payload, 99)
