@@ -89,7 +89,8 @@ def test_upgrade_v5_file_to_v6_and_run_new_jobs(tmp_path):
     db.tadetector.insert_rows([{"id": "old-job", "anomaly": "true"}])
     payload = _payload_from_db(db)
     migrate(payload, target=5)   # simulate the previous release's file
-    assert not any(k.startswith("flowpatterns/") for k in payload)
+    assert not any(k.startswith(("flowpatterns/", "spatialnoise/"))
+                   for k in payload)
     old = str(tmp_path / "v5.npz")
     np.savez_compressed(old, **payload)
 
