@@ -86,14 +86,32 @@ def test_delete_and_ingest_and_bundle_require_token(auth_server):
 
 
 def test_read_paths_stay_open(auth_server):
-    # healthz/version/stats/alerts/job GETs are the Grafana-style
+    # healthz/version/stats/job GETs are the Grafana-style coarse
     # read path (reference Grafana reads ClickHouse directly,
     # values.yaml:38-40) — no token needed.
-    for path in ("/healthz", "/version", "/alerts",
+    for path in ("/healthz", "/version",
                  "/apis/stats.theia.antrea.io/v1alpha1/clickhouse",
                  f"{GROUP}/throughputanomalydetectors"):
         code, _ = _call(auth_server, "GET", path)
         assert code == 200, path
+
+
+def test_alerts_and_dashboards_require_token(auth_server):
+    # /alerts and /dashboards/* serve decoded per-connection IPs —
+    # the same sensitivity class as the gated support bundles, so
+    # with auth configured they require the token too.
+    for path in ("/alerts", "/dashboards/api/homepage",
+                 "/dashboards/homepage"):
+        assert _status_of(lambda: _call(
+            auth_server, "GET", path)) == 401, path
+        assert _status_of(lambda: _call(
+            auth_server, "GET", path, token="wrong")) == 403, path
+    code, doc = _call(auth_server, "GET", "/alerts", token=TOKEN)
+    assert code == 200 and "alerts" in doc
+    assert doc["detectorShards"] >= 1
+    code, _ = _call(auth_server, "GET", "/dashboards/api/homepage",
+                    token=TOKEN)
+    assert code == 200
 
 
 def test_correct_token_admits_job_lifecycle(auth_server):

@@ -5,7 +5,13 @@ import json
 import ssl
 import urllib.request
 
-from theia_tpu.manager.certs import (
+import pytest
+
+pytest.importorskip(
+    "cryptography",
+    reason="certs subsystem needs the cryptography package")
+
+from theia_tpu.manager.certs import (  # noqa: E402
     apply_server_cert,
     cert_expiry,
     generate_self_signed,

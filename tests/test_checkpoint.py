@@ -103,6 +103,26 @@ def test_checkpoint_detects_same_size_churn(tmp_path):
     assert ck.checkpoint() is True           # content changed: writes
 
 
+def test_checkpoint_covers_every_result_table(tmp_path):
+    """The change fingerprint is built from the result-table REGISTRY:
+    rows landing in ANY result table (flowpatterns/spatialnoise
+    included — previously omitted) dirty the checkpoint, so a crash
+    can never silently lose a completed job's results."""
+    path = str(tmp_path / "f.npz")
+    db = FlowDatabase()
+    db.insert_flows(_batch(1))
+    ck = Checkpointer(db, path, interval=3600)
+    assert ck.checkpoint() is True
+    for name, table in db.result_tables.items():
+        row = {c.name: 1 for c in table.schema}
+        assert table.insert_rows([row]) == 1
+        assert ck.checkpoint() is True, (
+            f"{name} rows invisible to the change detector")
+        loaded = FlowDatabase.load(path)
+        assert len(loaded.result_tables[name]) == len(table), name
+    assert ck.checkpoint() is False   # unchanged again: skips
+
+
 def test_assume_current_skips_first_tick(tmp_path):
     db = FlowDatabase()
     db.insert_flows(_batch(10))

@@ -171,6 +171,161 @@ def test_concurrent_stream_resets_do_not_desync():
             assert f"172.16.{tid}.{b}" in srcs
 
 
+def _spike_payloads(n_streams, n_blocks, rows_per_block=48):
+    """Per-stream TFB2 block sequences with DISTINCT connection
+    populations and deterministic throughput spikes (so per-connection
+    EWMA alerts fire on known points)."""
+    t_base = 1_700_000_000
+    payloads = []
+    for sid in range(n_streams):
+        enc = BlockEncoder()
+        blocks = []
+        for b in range(n_blocks):
+            rows = [{
+                "sourceIP": f"10.{sid}.2.{i}",
+                "destinationIP": f"10.{sid}.3.{i % 12}",
+                "sourceTransportPort": 40000 + i,
+                "destinationTransportPort": 443,
+                "protocolIdentifier": 6,
+                "octetDeltaCount": 900 + i,
+                "packetDeltaCount": 2,
+                # steady-ish series with a large spike at block 4
+                "throughput": 1000 + 7 * i + (b % 3) +
+                (90000 if b == 4 else 0),
+                "timeInserted": t_base + b * 10,
+                "flowStartSeconds": t_base,
+                "flowEndSeconds": t_base + b * 10,
+            } for i in range(rows_per_block)]
+            blocks.append(enc.encode(
+                ColumnarBatch.from_rows(rows, FLOW_SCHEMA, enc.dicts)))
+        payloads.append(blocks)
+    return payloads
+
+
+def _conn_alert_sequences(im):
+    """connection_anomaly alerts grouped per connection identity, in
+    publication order (ring is newest-first, so reverse), with the
+    nondeterministic fields (latency, wall time, shard-local slot)
+    stripped."""
+    key_cols = ("sourceIP", "sourceTransportPort", "destinationIP",
+                "destinationTransportPort", "protocolIdentifier",
+                "flowStartSeconds")
+    seqs = {}
+    for a in reversed(im.recent_alerts(10_000)):
+        if a.get("kind") != "connection_anomaly":
+            continue
+        key = tuple(a[c] for c in key_cols)
+        seqs.setdefault(key, []).append(
+            (a["kind"], a["flowEndSeconds"], a["throughput"]))
+    return seqs
+
+
+def test_sharded_ingest_alerts_deterministic_vs_serial():
+    """The per-connection ordering guarantee of the sharded, pipelined
+    ingest path: N threads ingesting distinct streams produce exactly
+    the serial run's per-connection alert sequence (kind, connection
+    identity, order) — a key always hashes to the same shard, and a
+    shard applies one stream's batches in ack order."""
+    n_streams, n_blocks = 4, 6
+    serial_payloads = _spike_payloads(n_streams, n_blocks)
+    threaded_payloads = _spike_payloads(n_streams, n_blocks)
+
+    im_serial = IngestManager(FlowDatabase(), n_shards=4)
+    for sid in range(n_streams):
+        for p in serial_payloads[sid]:
+            im_serial.ingest(p, stream=f"s{sid}")
+
+    im_threaded = IngestManager(FlowDatabase(), n_shards=4)
+    errors = []
+
+    def feed(sid):
+        try:
+            for p in threaded_payloads[sid]:
+                im_threaded.ingest(p, stream=f"s{sid}")
+        except Exception as e:   # pragma: no cover
+            errors.append(f"stream {sid}: {e!r}")
+
+    threads = [threading.Thread(target=feed, args=(sid,))
+               for sid in range(n_streams)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+        assert not t.is_alive(), "ingest thread deadlocked"
+    assert not errors, errors
+
+    serial_seqs = _conn_alert_sequences(im_serial)
+    threaded_seqs = _conn_alert_sequences(im_threaded)
+    assert serial_seqs, "expected connection_anomaly alerts"
+    assert threaded_seqs == serial_seqs
+
+    # CMS updates are per-destination-shard too, so the final sketched
+    # volume of every destination matches the serial run exactly.
+    for sid in range(n_streams):
+        for i in range(12):
+            dst = f"10.{sid}.3.{i}"
+            est = []
+            for im in (im_serial, im_threaded):
+                code = im._global_dicts["destinationIP"].lookup(dst)
+                assert code is not None, dst
+                shard = im.shards[im.shard_of_destination(dst)]
+                est.append(shard.heavy.volume_estimate(code))
+            assert est[0] == est[1], dst
+    im_serial.close()
+    im_threaded.close()
+
+
+def test_shard_partition_is_stable():
+    """Same key → same shard: across batches, across manager
+    instances (restart), and matching the public stable-hash
+    assignment — detector state for a key can never migrate."""
+    payloads = _spike_payloads(1, 3)[0]
+    ims = [IngestManager(FlowDatabase(), n_shards=4) for _ in range(2)]
+    for im in ims:
+        for p in payloads:
+            im.ingest(p)
+    dests = [f"10.0.3.{i}" for i in range(12)]
+    for dst in dests:
+        shards = {im.shard_of_destination(dst) for im in ims}
+        assert len(shards) == 1, f"{dst} moved shards across restarts"
+        for im in ims:
+            code = im._global_dicts["destinationIP"].lookup(dst)
+            # the row-partition table agrees with the public hash
+            assert im._dst_shard[code] == im.shard_of_destination(dst)
+            # and the key's detector state actually lives there: its
+            # connections were slotted in exactly that shard's table
+            shard = im.shards[im.shard_of_destination(dst)]
+            assert shard.heavy.volume_estimate(code) > 0
+    # the population spreads over >1 shard (the test would otherwise
+    # not exercise partitioning at all)
+    assert len({ims[0].shard_of_destination(d) for d in dests}) > 1
+    for im in ims:
+        im.close()
+
+
+def test_pipelined_insert_leg_errors_surface():
+    """The store-insert leg runs overlapped with detector scoring; its
+    exceptions must still reach the producer (an acked row that never
+    hit the store would break row conservation silently)."""
+
+    class _FailingDB:
+        def insert_flows(self, batch):
+            raise RuntimeError("store exploded")
+
+    im = IngestManager(_FailingDB(), n_shards=2)
+    enc = BlockEncoder()
+    batch = ColumnarBatch.from_rows([{
+        "sourceIP": "10.9.9.1", "destinationIP": "10.9.9.2",
+        "octetDeltaCount": 10, "packetDeltaCount": 1,
+    }], FLOW_SCHEMA, enc.dicts)
+    try:
+        im.ingest(enc.encode(batch))
+        assert False, "expected the insert leg's error"
+    except RuntimeError as e:
+        assert "store exploded" in str(e)
+    im.close()
+
+
 def test_concurrent_jobs_and_ingest_no_deadlock():
     """Job lifecycle (create/read/delete) racing live ingest: the
     controller's result-table GC and the ingest path share the store;

@@ -110,6 +110,11 @@ def test_stats_api(server):
     disk = _get(server, "/apis/stats.theia.antrea.io/v1alpha1/"
                         "clickhouse/diskInfo")
     assert "tableInfos" not in disk
+    det = _get(server, "/apis/stats.theia.antrea.io/v1alpha1/"
+                       "clickhouse/detectorInfo")["detectorInfos"]
+    assert det["shards"] >= 1
+    assert len(det["series"]) == det["shards"]
+    assert det == doc["detectorInfos"]   # part of the bare GET too
 
 
 def test_support_bundle(server):

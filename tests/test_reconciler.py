@@ -78,6 +78,47 @@ def test_reconcile_is_level_triggered_and_idempotent(ctl, tmp_path):
     assert len(ctl.list()) == 1
 
 
+def test_restart_does_not_rerun_completed_cr(ctl, tmp_path):
+    """Manager restart (fresh controller, empty records) must NOT
+    re-admit a CR whose status file already records COMPLETED — the
+    reference controllers never re-execute a finished CR. A crash
+    mid-run (non-terminal status) still re-runs; removing the CR file
+    still GC's the stale status file."""
+    name = "tad-aaaaaaaa-bbbb-cccc-dddd-000000000010"
+    rec = DeclarativeReconciler(ctl, str(tmp_path))
+    _write_cr(tmp_path, name)
+    assert rec.reconcile_once()["created"] == 1
+    assert ctl.wait_all()
+    rec.reconcile_once()   # write COMPLETED status back
+
+    # "restart": a fresh controller with no records, same directory
+    db2 = FlowDatabase()
+    db2.insert_flows(generate_flows(SynthConfig(
+        n_series=6, points_per_series=12, seed=7)))
+    ctl2 = JobController(db2, workers=1)
+    try:
+        rec2 = DeclarativeReconciler(ctl2, str(tmp_path))
+        for _ in range(3):
+            assert rec2.reconcile_once()["created"] == 0
+        with pytest.raises(KeyError):
+            ctl2.get(name)   # never re-admitted, never re-run
+
+        # a non-terminal status (crash mid-run) DOES re-run
+        running = "tad-aaaaaaaa-bbbb-cccc-dddd-000000000011"
+        _write_cr(tmp_path, running)
+        (tmp_path / f"{running}.status.yaml").write_text(yaml.safe_dump(
+            {"name": running, "status": {"state": "RUNNING"}}))
+        assert rec2.reconcile_once()["created"] == 1
+        assert ctl2.wait_all()
+
+        # deleting the completed CR's file GC's its status file too
+        (tmp_path / f"{name}.yaml").unlink()
+        rec2.reconcile_once()
+        assert not (tmp_path / f"{name}.status.yaml").exists()
+    finally:
+        ctl2.shutdown()
+
+
 def test_rest_created_jobs_are_never_collected(ctl, tmp_path):
     rec = DeclarativeReconciler(ctl, str(tmp_path))
     rest_job = ctl.create(KIND_TAD, {"jobType": "EWMA"})

@@ -84,6 +84,10 @@ class HeavyHitterDetector:
         # Running mean distance scale (EW average) for the outlier band.
         self._dist_scale = 1.0
         self.batches = 0
+        #: total sketched volume after the last update (host float) —
+        #: peers in a sharded ensemble read this to evaluate shares
+        #: against the cluster total, not just this shard's.
+        self.total_volume = 0.0
 
     # -- feature engineering (vectorized, host side) ---------------------
 
@@ -120,7 +124,15 @@ class HeavyHitterDetector:
 
     # -- one micro-batch -------------------------------------------------
 
-    def update(self, batch: ColumnarBatch) -> List[HeavyHitterAlert]:
+    def update(self, batch: ColumnarBatch,
+               extra_total: float = 0.0) -> List[HeavyHitterAlert]:
+        """Advance the sketch/centroids with one micro-batch.
+
+        `extra_total` is volume held by OTHER detector shards in a
+        sharded ensemble: the phi-heavy-hitter share is evaluated
+        against (this shard's total + extra_total), so a destination's
+        share still means its fraction of the whole cluster's traffic
+        when the key space is partitioned."""
         if len(batch) == 0:
             return []
         n = len(batch)
@@ -154,13 +166,15 @@ class HeavyHitterDetector:
             (est_d, self.cms.total, dist_d))
         est = est[:len(uniq_codes)]
         total = float(total)
+        self.total_volume = total
         dist = dist[:n]
         self.batches += 1
 
         alerts: List[HeavyHitterAlert] = []
         dst_dict = batch.dicts.get("destinationIP")
-        if total > 0:
-            share = est / total
+        grand_total = total + max(float(extra_total), 0.0)
+        if grand_total > 0:
+            share = est / grand_total
             for code, e, s in zip(uniq_codes, est, share):
                 if s >= self.hh_fraction:
                     name = (dst_dict.decode_one(int(code))

@@ -20,6 +20,13 @@ connection keys (packed 6-tuples of dictionary codes) to slots on first
 sight. Capacity overflow evicts nothing — new series beyond capacity
 are dropped and counted, mirroring how a fixed-size flow cache degrades.
 
+Sharding: a StreamingDetector is deliberately single-writer (callers
+serialize updates). The manager's ingest path scales it by running N
+independent instances, one per destination-hash shard, each behind its
+own lock (manager/ingest.py) — the per-slot recurrence only ever reads
+its own slot's state, so partitioning the key space partitions the
+state with no cross-shard coupling.
+
 Hot-path shape: one micro-batch is ONE jitted device step however many
 rows it carries. The step gathers only the U slots present in the batch,
 scans the (usually 1-2) ticks of duplicate points per connection over a

@@ -57,6 +57,11 @@ def main(argv=None) -> None:
                    help="flow store shards (the reference's ClickHouse "
                         "`shards` Helm value; >1 uses the Distributed-"
                         "table equivalent)")
+    p.add_argument("--ingest-shards", type=int, default=None,
+                   help="detector shards on the ingest path (default: "
+                        "THEIA_INGEST_SHARDS env, else min(8, cores)); "
+                        "concurrent producer streams score "
+                        "concurrently, one lock per shard")
     p.add_argument("--replicas", type=int, default=1,
                    help="live copies of the logical store (the "
                         "reference's `replicas` Helm value / "
@@ -168,7 +173,8 @@ def main(argv=None) -> None:
         tls_cert_dir=args.tls_cert_dir, tls_cert=args.tls_cert,
         tls_key=args.tls_key, tls_ca=args.tls_ca,
         auth_token=args.auth_token,
-        auth_token_file=args.auth_token_file)
+        auth_token_file=args.auth_token_file,
+        ingest_shards=args.ingest_shards)
     if server.auth_token:
         print("API authentication enabled (bearer token)",
               file=sys.stderr)

@@ -24,13 +24,17 @@ Authentication: the reference delegates authn/authz to kube-apiserver
 ServiceAccount bearer token (pkg/theia/commands/utils.go:122-144). The
 equivalent here is a static bearer token (`auth_token`): when set,
 every request that can mutate state or exfiltrate data — POST (job
-create, /ingest, bundle collect), DELETE, and the system group's
-bundle status/download — must carry `Authorization: Bearer <token>`.
-A missing/malformed header is 401 (unauthenticated); a well-formed but
-wrong token is 403 (unauthorized). Read-only observability (healthz,
-version, stats, dashboards, alerts, job GETs) stays open, playing the
-role of the reference's unauthenticated Grafana read path (Grafana
-queries ClickHouse directly, values.yaml:38-40).
+create, /ingest, bundle collect), DELETE, the system group's bundle
+status/download, AND the telemetry read paths that serve decoded
+flow identities (GET /alerts, /dashboards/*) — must carry
+`Authorization: Bearer <token>`. A missing/malformed header is 401
+(unauthenticated); a well-formed but wrong token is 403
+(unauthorized). Coarse read-only observability (healthz, version,
+stats, job GETs) stays open, playing the role of the reference's
+unauthenticated Grafana read path (Grafana queries ClickHouse
+directly, values.yaml:38-40) — but unlike that in-cluster path this
+server can bind 0.0.0.0, so anything carrying per-connection IPs is
+gated.
 """
 
 from __future__ import annotations
@@ -336,10 +340,15 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
     def _get(self) -> None:
         parts = self._route()
         if parts == ("alerts",):
+            # Alerts carry decoded source/destination IPs — the same
+            # sensitivity class as the gated support bundles, so the
+            # token (when configured) is required here too.
+            self._require_auth()
             limit = int(self._query().get("limit", "100"))
             self._send_json(
                 {"alerts": self.ingest.recent_alerts(limit),
-                 "rowsIngested": self.ingest.rows_ingested})
+                 "rowsIngested": self.ingest.rows_ingested,
+                 "detectorShards": self.ingest.n_shards})
             return
         if parts == ("healthz",):
             self._send_json({"status": "ok"})
@@ -369,6 +378,11 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
         /dashboards/api/<name>?format=grafana → a Grafana-importable
         dashboard JSON (the reference's provisioned *.json equivalent,
         build/charts/theia/provisioning/dashboards/)."""
+        # Dashboard pages and their JSON datasource serve the same
+        # decoded per-flow identities the alerts do (the HTML embeds
+        # the data server-side), so the whole surface is token-gated
+        # when auth is configured.
+        self._require_auth()
         import inspect
 
         from ..dashboards import DASHBOARDS, grafana_dashboard, render
@@ -413,7 +427,7 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
             raise KeyError(self.path)
 
     _STATS_COMPONENTS = ("diskInfo", "tableInfo", "insertRate",
-                         "stackTraces", "deviceInfo")
+                         "stackTraces", "deviceInfo", "detectorInfo")
 
     def _get_stats(self, parts) -> None:
         if len(parts) < 4 or parts[3] != "clickhouse":
@@ -434,6 +448,11 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
             doc["insertRates"] = self.stats.insert_rates()
         if component in (None, "stackTraces"):
             doc["stackTraces"] = self.stats.stack_traces()
+        if component in (None, "detectorInfo"):
+            # Shard counts and per-shard series occupancy of the
+            # ingest-path detector ensemble (no decoded identities —
+            # stays on the open read path with the rest of stats).
+            doc["detectorInfos"] = self.ingest.detector_stats()
         if component == "deviceInfo":
             # Opt-in only (not part of the bare-resource GET): touching
             # jax.devices() initializes a backend, which an operator
@@ -574,9 +593,10 @@ class TheiaManagerServer:
                  tls_key: Optional[str] = None,
                  tls_ca: Optional[str] = None,
                  auth_token: Optional[str] = None,
-                 auth_token_file: Optional[str] = None) -> None:
+                 auth_token_file: Optional[str] = None,
+                 ingest_shards: Optional[int] = None) -> None:
         from .ingest import IngestManager
-        self.ingest = IngestManager(db)
+        self.ingest = IngestManager(db, n_shards=ingest_shards)
         self.controller = JobController(
             db, workers=workers, dispatch=dispatch,
             alert_sink=self.ingest.push_alert)
@@ -632,6 +652,7 @@ class TheiaManagerServer:
         if self._serving:
             self.httpd.shutdown()
         self.httpd.server_close()
+        self.ingest.close()
         self.controller.shutdown()
         if self._thread:
             self._thread.join(timeout=2)

@@ -25,15 +25,42 @@ alert surface at all — its analytics are batch jobs
 (plugins/anomaly-detection/anomaly_detection.py); this is the
 sub-second path the BASELINE north star asks for, made reachable over
 the wire.
+
+Concurrency shape (the shard-parallel, pipelined path):
+
+  * Detector state is partitioned by destination into N_SHARDS
+    independent shards (THEIA_INGEST_SHARDS, default min(8, cores)),
+    each holding its own HeavyHitterDetector + StreamingDetector and
+    its own lock — concurrent producer streams score concurrently
+    instead of queueing on one global detector lock.
+  * Within one request the two independent legs — the store insert
+    (MV fan-out, TTL) and detector scoring — run overlapped, so
+    request latency is max(legs), not their sum.
+  * The ingest-global dictionary remap has its own fine-grained lock;
+    minting a new global code never stalls another shard's scoring.
+
+Ordering guarantee: alerts are deterministic PER CONNECTION. A
+destination always hashes to the same shard (a stable string hash,
+not a dictionary code — so the assignment survives restarts), the
+connection 6-tuple contains the destination, and a shard applies one
+stream's batches in ack order; so each connection's EWMA/CMS state
+sees its own points in exactly the order the producer sent them,
+whatever other streams do concurrently. There is no GLOBAL alert
+order across connections, and heavy-hitter shares are evaluated
+against an eventually-consistent cluster-total volume (a shard reads
+its peers' last-published totals without locking them).
 """
 
 from __future__ import annotations
 
 import collections
 import dataclasses
+import os
 import threading
 import time
-from typing import Deque, Dict, List, Optional
+import zlib
+from concurrent.futures import ThreadPoolExecutor
+from typing import Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -42,6 +69,7 @@ from ..analytics.streaming import StreamingDetector
 from ..ingest.native import BLOCK_MAGIC, BLOCK_MAGIC_V1, TsvDecoder
 from ..schema import ColumnarBatch, DictionaryMapper, StringDictionary
 from ..utils import get_logger
+from ..utils.env import env_int
 
 logger = get_logger("ingest")
 
@@ -49,6 +77,16 @@ MAX_ALERTS = 1000
 
 
 MAX_STREAMS = 64
+
+
+def default_ingest_shards() -> int:
+    """Detector shard count: THEIA_INGEST_SHARDS wins, else one shard
+    per host core up to 8 (past that the slices get too small to beat
+    the per-slice dispatch overhead)."""
+    n = env_int("THEIA_INGEST_SHARDS", 0)
+    if n <= 0:
+        n = min(8, os.cpu_count() or 1)
+    return max(1, n)
 
 
 class StreamCapacityError(Exception):
@@ -63,8 +101,24 @@ class _Stream:
         self.last_used = time.monotonic()
 
 
+class DetectorShard:
+    """One independently-lockable partition of detector state: its own
+    CMS/k-means heavy-hitter detector and its own EWMA slot table.
+    Keys are routed here by stable destination hash, so a given
+    destination's (and therefore connection's) whole history lives in
+    exactly one shard — per-key update order is preserved however many
+    shards run concurrently."""
+
+    def __init__(self, index: int, heavy: HeavyHitterDetector,
+                 streaming: StreamingDetector) -> None:
+        self.index = index
+        self.heavy = heavy
+        self.streaming = streaming
+        self.lock = threading.Lock()
+
+
 class IngestManager:
-    """Serialized ingest path: wire bytes → store + streaming detector.
+    """Shard-parallel ingest path: wire bytes → store ∥ detectors.
 
     Each producer is a *stream* (`?stream=<id>`, default "default")
     with its own decoder, because a TFB2 block sequence carries
@@ -89,20 +143,37 @@ class IngestManager:
     IDLE_EVICT_SECONDS = 300.0
 
     #: string key columns remapped to ingest-global codes before
-    #: scoring (both detectors key on them; see _global_codes)
+    #: scoring (both detectors key on them; see _remap_global)
     GLOBAL_COLUMNS = ("sourceIP", "destinationIP")
 
     def __init__(self, db, detector: Optional[HeavyHitterDetector] = None,
-                 streaming: Optional[StreamingDetector] = None) -> None:
+                 streaming: Optional[StreamingDetector] = None,
+                 n_shards: Optional[int] = None) -> None:
         self.db = db
         self._streams: Dict[str, _Stream] = {}
         self._registry_lock = threading.Lock()
-        self.detector = detector or HeavyHitterDetector()
-        self.streaming = streaming or StreamingDetector()
-        # Detector state (device compute) and the alert ring have
-        # separate locks: GET /alerts only touches the cheap ring lock,
-        # never waiting behind scoring or JIT compilation.
-        self._detector_lock = threading.Lock()
+        # Injected detector instances pin the manager to ONE shard
+        # (there is a single state table to keep coherent); otherwise
+        # detector state shards n_shards ways.
+        if detector is not None or streaming is not None:
+            n_shards = 1
+        elif n_shards is None:
+            n_shards = default_ingest_shards()
+        self.n_shards = max(1, int(n_shards))
+        self.shards: List[DetectorShard] = [
+            DetectorShard(i,
+                          detector if detector is not None
+                          else HeavyHitterDetector(),
+                          streaming if streaming is not None
+                          else StreamingDetector())
+            for i in range(self.n_shards)]
+        # Last-published CMS total per shard: peers read these without
+        # taking the owner's lock, so heavy-hitter shares measure an
+        # eventually-consistent cluster total instead of serializing
+        # every shard on every batch.
+        self._shard_totals = np.zeros(self.n_shards, np.float64)
+        # The alert ring has its own cheap lock: GET /alerts never
+        # waits behind scoring or JIT compilation.
         self._alerts_lock = threading.Lock()
         self._alerts: Deque[Dict[str, object]] = collections.deque(
             maxlen=MAX_ALERTS)
@@ -113,13 +184,35 @@ class IngestManager:
         # dictionaries before scoring (cached incremental mappings,
         # schema.DictionaryMapper — no string objects on the hot
         # path). Sized to survive reset churn across MAX_STREAMS
-        # producers; serialized by the detector lock.
+        # producers. The remap has its OWN fine-grained lock so dict
+        # maintenance for one batch never blocks another batch's
+        # shard scoring.
+        self._dict_lock = threading.Lock()
         self._global_dicts: Dict[str, StringDictionary] = {
             c: StringDictionary() for c in self.GLOBAL_COLUMNS}
         self._mappers: Dict[str, DictionaryMapper] = {
             c: DictionaryMapper(self._global_dicts[c],
                                 max_entries=2 * MAX_STREAMS)
             for c in self.GLOBAL_COLUMNS}
+        # destination global code → shard, extended lazily as codes
+        # are minted (each new destination string is hashed ONCE; the
+        # per-row partition is then a pure integer gather).
+        self._dst_shard = np.zeros(1, np.int64)   # code 0: ""
+        # Pipelining pool for the store-insert leg (the groupsum MV
+        # fan-out releases the GIL, so it genuinely overlaps the
+        # detector leg's numpy/XLA work). Each in-flight request holds
+        # at most one insert, so size to request concurrency — host
+        # parallelism with headroom, capped at the stream slot count —
+        # NOT to the detector shard count, which is unrelated to
+        # insert parallelism.
+        self._insert_pool = ThreadPoolExecutor(
+            max_workers=min(MAX_STREAMS,
+                            max(4, 2 * (os.cpu_count() or 1))),
+            thread_name_prefix="theia-ingest-insert")
+
+    def close(self) -> None:
+        """Release the pipelining pool's threads (idempotent)."""
+        self._insert_pool.shutdown(wait=False)
 
     def _stream(self, stream_id: str) -> _Stream:
         with self._registry_lock:
@@ -153,7 +246,7 @@ class IngestManager:
 
     def ingest(self, payload: bytes,
                stream: str = "default") -> Dict[str, object]:
-        """Decode one wire payload, insert, score. Raises ValueError on
+        """Decode one wire payload, insert ∥ score. Raises ValueError on
         malformed payloads (mapped to HTTP 400 by the API layer); the
         failing stream is reset and must restart its encoder."""
         st = self._stream(stream)
@@ -183,32 +276,29 @@ class IngestManager:
                 # discard the stream rather than serve a desynced one.
                 self._drop_stream(stream, st)
                 raise
-        n = self.db.insert_flows(batch)
-        with self._detector_lock:
-            # Re-encode the string key columns against the
-            # ingest-global dictionaries: detector state (CMS counts,
-            # per-connection slots) persists across batches, so keys
-            # must mean the same endpoint whichever stream (or stream
-            # generation) produced the batch.
-            scored = ColumnarBatch(
-                {**batch.columns,
-                 **{c: self._global_codes(c, batch)
-                    for c in self.GLOBAL_COLUMNS}},
-                {**batch.dicts,
-                 **{c: self._global_dicts[c]
-                    for c in self.GLOBAL_COLUMNS}})
-            alerts = self.detector.update(scored)
-            raw_conn = self.streaming.ingest(scored)
-            # The ring keeps MAX_ALERTS; in an alert storm only the
-            # newest survive, so only those are worth decoding.
-            n_conn = len(raw_conn)
-            conn_alerts = []
-            for a in raw_conn[-MAX_ALERTS:]:
-                described = self.streaming.describe_alert(scored, a)
-                # "row" is batch-local; meaningless once published
-                described.pop("row", None)
-                described["kind"] = "connection_anomaly"
-                conn_alerts.append(described)
+        # Pipelined legs: the store insert (MV fan-out, TTL) and the
+        # detector scoring are independent consumers of the decoded
+        # batch (both read-only), so they run overlapped and the
+        # request completes in max(legs), not their sum. Consequence
+        # for a FAILED insert: scoring has already advanced detector
+        # sketch state (that can't be rolled back), so a producer
+        # retrying the 5xx'd payload counts those rows twice in the
+        # detectors — at-least-once detector semantics, where the
+        # pre-pipelined path skipped scoring on insert failure. The
+        # batch's alerts are still withheld (published only after the
+        # insert leg succeeds, below), and the store itself stays
+        # exactly-once.
+        fut = self._insert_pool.submit(self.db.insert_flows, batch)
+        try:
+            alerts, conn_alerts, n_conn = self.score_batch(batch)
+        finally:
+            # Always await the insert leg, even when scoring raised:
+            # an unawaited future would hide the store's exception and
+            # break the acked-rows conservation contract.
+            insert_exc = fut.exception()
+        if insert_exc is not None:
+            raise insert_exc
+        n = fut.result()
         now = time.time()
         n_alerts = len(alerts) + n_conn
         with self._alerts_lock:
@@ -222,12 +312,153 @@ class IngestManager:
             logger.v(1).info("ingested %d rows, %d alerts", n, n_alerts)
         return {"rows": n, "alerts": n_alerts}
 
-    def _global_codes(self, column: str,
-                      batch: ColumnarBatch) -> np.ndarray:
-        """Stream-local → ingest-global codes for `column` (caller
-        holds the detector lock)."""
-        return self._mappers[column].remap(batch[column],
-                                           batch.dicts[column])
+    # -- detector leg ----------------------------------------------------
+
+    def score_batch(self, batch: ColumnarBatch
+                    ) -> Tuple[List, List[Dict[str, object]], int]:
+        """Advance every shard whose keys appear in `batch`; returns
+        (heavy-hitter alerts, described connection alerts, raw
+        connection-alert count). Only the touched shard's lock is held
+        while its slice scores, and free shards are taken first (see
+        below), so requests whose keys land on different shards never
+        wait on each other."""
+        if len(batch) == 0:
+            return [], [], 0
+        scored, shard_ids = self._remap_global(batch)
+        hh_alerts: List = []
+        raw_alerts: List[Tuple[DetectorShard, ColumnarBatch, Dict]] = []
+        n_conn = 0
+        # Opportunistic acquisition: score whichever touched shard is
+        # free NOW, blocking only when every remaining shard is busy.
+        # A fixed index-order visit would convoy concurrent requests
+        # at shard 0 (every batch's keys usually span all shards);
+        # visit order across shards is free to vary because slices of
+        # one batch hold disjoint key sets — per-connection order is
+        # enforced by the shard lock alone.
+        pending: Deque = collections.deque(
+            self._partition(scored, shard_ids))
+        while pending:
+            progressed = False
+            for _ in range(len(pending)):
+                shard, part = pending.popleft()
+                if shard.lock.acquire(blocking=False):
+                    try:
+                        n_conn += self._score_shard(
+                            shard, part, hh_alerts, raw_alerts)
+                    finally:
+                        shard.lock.release()
+                    progressed = True
+                else:
+                    pending.append((shard, part))
+            if not progressed and pending:
+                shard, part = pending.popleft()
+                with shard.lock:
+                    n_conn += self._score_shard(
+                        shard, part, hh_alerts, raw_alerts)
+        # The ring keeps MAX_ALERTS; in an alert storm only the newest
+        # survive, so only those are worth decoding — capped over the
+        # WHOLE batch, not per shard slice, and decoded outside any
+        # shard lock (describe_alert only reads the slice + dicts).
+        conn_alerts: List[Dict[str, object]] = []
+        for shard, part, a in raw_alerts[-MAX_ALERTS:]:
+            described = shard.streaming.describe_alert(part, a)
+            # "row" is batch-local; meaningless once published
+            described.pop("row", None)
+            described["kind"] = "connection_anomaly"
+            conn_alerts.append(described)
+        return hh_alerts, conn_alerts, n_conn
+
+    def _score_shard(self, shard: DetectorShard, part: ColumnarBatch,
+                     hh_alerts: List,
+                     raw_alerts: List[Tuple["DetectorShard",
+                                            ColumnarBatch, Dict]]) -> int:
+        """Advance ONE shard with its slice (caller holds shard.lock);
+        appends heavy-hitter alerts and undecoded connection alerts
+        (decoding is the caller's, outside the lock), returns the raw
+        connection-alert count. The key columns already carry
+        ingest-global codes: detector state (CMS counts,
+        per-connection slots) persists across batches, so keys must
+        mean the same endpoint whichever stream (or stream generation)
+        produced the batch."""
+        extra = float(self._shard_totals.sum()
+                      - self._shard_totals[shard.index])
+        hh_alerts.extend(shard.heavy.update(part, extra_total=extra))
+        self._shard_totals[shard.index] = shard.heavy.total_volume
+        raw_conn = shard.streaming.ingest(part)
+        raw_alerts.extend((shard, part, a) for a in raw_conn)
+        return len(raw_conn)
+
+    def _remap_global(self, batch: ColumnarBatch
+                      ) -> Tuple[ColumnarBatch, Optional[np.ndarray]]:
+        """Stream-local → ingest-global codes for the key columns, and
+        the per-row shard assignment. Only the dictionary lock is held
+        — shard scoring proceeds concurrently."""
+        with self._dict_lock:
+            gcols = {c: self._mappers[c].remap(batch[c],
+                                               batch.dicts[c])
+                     for c in self.GLOBAL_COLUMNS}
+            dst_shard = (self._dst_shard_table()
+                         if self.n_shards > 1 else None)
+        scored = ColumnarBatch(
+            {**batch.columns, **gcols},
+            {**batch.dicts,
+             **{c: self._global_dicts[c]
+                for c in self.GLOBAL_COLUMNS}})
+        shard_ids = (dst_shard[gcols["destinationIP"]]
+                     if dst_shard is not None else None)
+        return scored, shard_ids
+
+    def _dst_shard_table(self) -> np.ndarray:
+        """code → shard for every destination code minted so far
+        (caller holds the dictionary lock). Each NEW destination
+        string is hashed once at mint time; rows then partition by a
+        pure integer gather. The hash is over the string bytes, not
+        the code, so the assignment is stable across restarts and
+        ingestion orders."""
+        d = self._global_dicts["destinationIP"]
+        have = len(self._dst_shard)
+        if have < len(d):
+            fresh = np.fromiter(
+                (self.shard_of_destination(s)
+                 for s in d.entries_since(have)),
+                dtype=np.int64)
+            self._dst_shard = np.concatenate([self._dst_shard, fresh])
+        return self._dst_shard
+
+    def shard_of_destination(self, destination: str) -> int:
+        """Stable shard assignment for a destination string (crc32 of
+        the UTF-8 bytes mod n_shards — identical across processes,
+        restarts, and ingestion orders)."""
+        return zlib.crc32(
+            destination.encode("utf-8", "surrogatepass")) % self.n_shards
+
+    def _partition(self, scored: ColumnarBatch,
+                   shard_ids: Optional[np.ndarray]):
+        """Yield (shard, slice) for each shard with rows in `scored`,
+        in shard-index order. Row order within a slice is batch order,
+        so each connection's points reach its shard's recurrence in
+        arrival order."""
+        if shard_ids is None:
+            yield self.shards[0], scored
+            return
+        for s in range(self.n_shards):
+            idx = np.flatnonzero(shard_ids == s)
+            if idx.size == 0:
+                continue
+            if idx.size == len(scored):
+                yield self.shards[s], scored
+                return
+            yield self.shards[s], scored.take(idx)
+
+    def detector_stats(self) -> Dict[str, object]:
+        """Operator view of the sharded detector ensemble."""
+        return {
+            "shards": self.n_shards,
+            "series": [s.streaming.n_series for s in self.shards],
+            "droppedSeries": [s.streaming.dropped_series
+                              for s in self.shards],
+            "totalVolume": float(self._shard_totals.sum()),
+        }
 
     def push_alert(self, alert: Dict[str, object]) -> None:
         """Publish an externally produced alert (e.g. a completed

@@ -35,7 +35,8 @@ from typing import Dict, Optional, Tuple
 
 from ..utils import get_logger
 from .jobs import (KIND_DD, KIND_FPM, KIND_NPR, KIND_SPATIAL,
-                   KIND_TAD, DuplicateJobError)
+                   KIND_TAD, STATE_COMPLETED, STATE_FAILED,
+                   DuplicateJobError)
 
 logger = get_logger("reconciler")
 
@@ -70,6 +71,9 @@ class DeclarativeReconciler:
         #: last status written per name — unchanged statuses skip the
         #: disk write (and the watcher events it would trigger)
         self._last_status: Dict[str, dict] = {}
+        #: CRs whose status file already records a terminal state —
+        #: skipped (and logged) once, not re-read every pass
+        self._terminal: Dict[str, str] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         os.makedirs(directory, exist_ok=True)
@@ -154,6 +158,21 @@ class DeclarativeReconciler:
             fingerprint = (kind, repr(sorted(spec.items())))
             if self._rejected.get(name) == fingerprint:
                 continue   # logged once; retried only if spec changes
+            if name not in self._terminal:
+                state = self._terminal_state_on_disk(name)
+                if state is not None:
+                    # The CR already ran to a terminal state in a
+                    # previous manager life (the status file beside it
+                    # is the durable record — the reference controllers
+                    # never re-execute a completed CR either). Claim
+                    # ownership so the status file is GC'd with the CR.
+                    self._terminal[name] = state
+                    self._owned.add(name)
+                    logger.v(1).info(
+                        "CR %s already %s (status file); not "
+                        "re-admitting after restart", name, state)
+            if name in self._terminal:
+                continue
             try:
                 self.controller.create(kind, spec, name=name)
                 self._owned.add(name)
@@ -183,6 +202,7 @@ class DeclarativeReconciler:
             self._owned.discard(name)
             self._remove_status(name)
             self._last_status.pop(name, None)
+            self._terminal.pop(name, None)
 
         self._write_statuses(desired)
         return {"desired": len(desired), "created": created,
@@ -192,6 +212,28 @@ class DeclarativeReconciler:
 
     def _status_path(self, name: str) -> str:
         return os.path.join(self.directory, name + _STATUS_SUFFIX)
+
+    def _terminal_state_on_disk(self, name: str) -> Optional[str]:
+        """COMPLETED/FAILED from `<name>.status.yaml` if the CR already
+        ran to completion (written atomically by _write_statuses), else
+        None. Unreadable/missing/non-terminal statuses mean the CR is
+        still due to run — a crash mid-run re-runs, a finished run
+        never does."""
+        import yaml
+
+        try:
+            with open(self._status_path(name)) as f:
+                doc = yaml.safe_load(f)
+        except OSError:
+            return None
+        except Exception:
+            return None   # torn/foreign file: treat as no status
+        if not isinstance(doc, dict):
+            return None
+        state = ((doc.get("status") or {}).get("state")
+                 if isinstance(doc.get("status"), dict) else None)
+        return state if state in (STATE_COMPLETED, STATE_FAILED) \
+            else None
 
     def _remove_status(self, name: str) -> None:
         try:

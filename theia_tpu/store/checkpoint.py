@@ -111,11 +111,14 @@ class Checkpointer:
         """Change detector: per-table monotonic mutation counters
         (Table.generation counts inserts AND deletes, so same-size
         churn — TTL evicts N while ingest adds N — still registers;
-        row counts alone would not)."""
+        row counts alone would not). Built from the result-table
+        REGISTRY, not a hardcoded table list: a result table added to
+        the store is covered automatically, so a completed job's rows
+        can never be invisible to the change detector (and silently
+        lost to a crash)."""
         return (self.db.flows.generation,
-                self.db.tadetector.generation,
-                self.db.recommendations.generation,
-                self.db.dropdetection.generation)
+                *(self.db.result_tables[name].generation
+                  for name in sorted(self.db.result_tables)))
 
     def checkpoint(self) -> bool:
         """Write one snapshot (FlowDatabase.save is itself atomic:
