@@ -55,7 +55,7 @@ def test_manager_over_tls(tmp_path):
         with urllib.request.urlopen(
                 f"https://localhost:{srv.port}/healthz", timeout=10,
                 context=ctx) as r:
-            assert json.loads(r.read()) == {"status": "ok"}
+            assert json.loads(r.read())["status"] == "ok"
     finally:
         srv.shutdown()
 
@@ -96,7 +96,7 @@ def test_tls_slow_client_does_not_block_server(tmp_path):
         with urllib.request.urlopen(
                 f"https://localhost:{srv.port}/healthz", timeout=10,
                 context=ctx) as r:
-            assert json.loads(r.read()) == {"status": "ok"}
+            assert json.loads(r.read())["status"] == "ok"
         assert _time.monotonic() - t0 < 5
         stalker.close()
     finally:

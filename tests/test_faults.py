@@ -1,0 +1,617 @@
+"""Fault injection + self-healing failure domains.
+
+The acceptance contract of the robustness PR: an injected per-replica
+write error quarantines exactly the faulty replica while the write
+lands on survivors, the repair loop resyncs it back to byte-for-byte
+parity with the active peer, a fault-hung runner child dies at its
+deadline with DeadlineExceeded, and a transiently-failing job retries
+to success with its attempt count in status — in both dispatch modes.
+
+All backoff clocks are injectable; no test sleeps longer than the
+subprocess-spawn tests inherently need.
+"""
+
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from theia_tpu.data.synth import SynthConfig, generate_flows
+from theia_tpu.manager.jobs import (
+    KIND_NPR,
+    KIND_TAD,
+    STATE_COMPLETED,
+    STATE_FAILED,
+    STATE_SCHEDULED,
+    JobController,
+)
+from theia_tpu.store import (
+    Checkpointer,
+    FlowDatabase,
+    ReplicaRepairLoop,
+    ReplicatedFlowDatabase,
+)
+from theia_tpu.utils import faults
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    """Every test starts and ends with no faults armed (the injector
+    is process-global)."""
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+def _batch(seed, n=6, t=10):
+    return generate_flows(SynthConfig(n_series=n, points_per_series=t,
+                                      seed=seed))
+
+
+def _job_db():
+    d = FlowDatabase()
+    d.insert_flows(generate_flows(SynthConfig(
+        n_series=8, points_per_series=20, anomaly_fraction=0.4,
+        anomaly_magnitude=60.0, seed=11)))
+    return d
+
+
+# -- framework ----------------------------------------------------------
+
+
+def test_parse_spec_full_grammar():
+    rules = faults.parse_spec(
+        "store.insert:error:0.5,runner.exec:hang,replica.write:error@2")
+    assert rules["store.insert"].mode == "error"
+    assert rules["store.insert"].probability == 0.5
+    assert rules["store.insert"].nth is None
+    assert rules["runner.exec"].mode == "hang"
+    assert rules["replica.write"].nth == 2
+    assert rules["replica.write"].probability == 1.0
+
+
+@pytest.mark.parametrize("bad", [
+    "store.insert", "x:explode", "x:error:2.0", "x:error:0",
+    "x:error@0", "x:error@x", "x:error:0.5:junk"])
+def test_parse_spec_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        faults.parse_spec(bad)
+
+
+def test_nth_is_one_shot():
+    faults.arm("x:error@2")
+    faults.fire("x")                      # hit 1: passes
+    with pytest.raises(faults.FaultError):
+        faults.fire("x")                  # hit 2: fires
+    faults.fire("x")                      # hit 3: spent, passes
+    assert faults.injector().counts()["x"] == 3
+
+
+def test_probability_is_seed_deterministic():
+    def pattern(seed):
+        faults.arm("x:error:0.5", seed=seed)
+        out = []
+        for _ in range(64):
+            try:
+                faults.fire("x")
+                out.append(0)
+            except faults.FaultError:
+                out.append(1)
+        return out
+
+    assert pattern(7) == pattern(7)
+    assert pattern(7) != pattern(8)
+    assert 0 < sum(pattern(7)) < 64
+
+
+def test_hang_mode_sleeps_then_proceeds():
+    faults.arm("x:hang", hang_seconds=0.05)
+    t0 = time.monotonic()
+    faults.fire("x")   # returns (no error) after the hang window
+    assert time.monotonic() - t0 >= 0.04
+
+
+def test_env_arming_reaches_store_insert(monkeypatch):
+    monkeypatch.setenv("THEIA_FAULTS", "store.insert:error")
+    faults.arm_from_env()
+    with pytest.raises(faults.FaultError):
+        FlowDatabase().insert_flows(_batch(1))
+    monkeypatch.delenv("THEIA_FAULTS")
+    faults.arm_from_env()   # unset env disarms
+    assert faults.injector() is None
+
+
+# -- replica quarantine + repair ---------------------------------------
+
+
+def test_partial_fanout_quarantines_and_repairs(monkeypatch):
+    """The acceptance path, env-armed: one-shot per-replica write
+    error → write lands on the survivor, faulty replica quarantined,
+    repair loop re-admits it with state identical to the peer."""
+    monkeypatch.setenv("THEIA_FAULTS", "replica.write:error@2")
+    faults.arm_from_env()
+    db = ReplicatedFlowDatabase(replicas=2)
+    n = db.insert_flows(_batch(1))   # hit 1 = replica 0, hit 2 fires
+    assert n == 60                   # survivors took the write
+    m = db.membership()
+    assert m["down"] == [1]
+    assert list(m["quarantined"]) == ["1"]
+    assert "FaultError" in m["quarantined"]["1"]["reason"]
+    assert len(db.replicas[0].flows) == 60
+    assert len(db.replicas[1].flows) == 0    # no silent divergence
+
+    # degraded writes keep landing on the survivor
+    db.insert_flows(_batch(2))
+    assert len(db.replicas[0].flows) == 120
+
+    loop = ReplicaRepairLoop(db, base_backoff=0.01)
+    assert loop.repair_once() == [1]
+    assert db.membership() == {"replicas": 2, "live": [0, 1],
+                               "down": [], "quarantined": {}}
+    a, b = (r.flows.scan() for r in db.replicas)
+    assert len(a) == len(b) == 120
+    assert sorted(zip(a.strings("sourceIP"),
+                      np.asarray(a["flowEndSeconds"]).tolist())) == \
+        sorted(zip(b.strings("sourceIP"),
+                   np.asarray(b["flowEndSeconds"]).tolist()))
+    assert len(db.replicas[0].views["flows_pod_view"]) == \
+        len(db.replicas[1].views["flows_pod_view"])
+
+
+def test_uniform_fanout_failure_does_not_quarantine():
+    """Every replica failing identically = bad request (nothing was
+    applied, no divergence): the error propagates, nobody is
+    quarantined."""
+    faults.arm("replica.write:error")   # every hit, every replica
+    db = ReplicatedFlowDatabase(replicas=2)
+    with pytest.raises(faults.FaultError):
+        db.insert_flows(_batch(3))
+    faults.disarm()
+    assert db.membership()["quarantined"] == {}
+    assert db.membership()["down"] == []
+    assert db.insert_flows(_batch(3)) == 60   # fully recovered
+
+
+def test_result_table_fanout_quarantines_too():
+    faults.arm("replica.write:error@2")
+    db = ReplicatedFlowDatabase(replicas=2)
+    db.tadetector.insert_rows([{"id": "j1", "anomaly": "true"}])
+    assert db.membership()["down"] == [1]
+    assert len(db.replicas[0].tadetector) == 1
+    assert ReplicaRepairLoop(db).repair_once() == [1]
+    assert len(db.replicas[1].tadetector) == 1
+
+
+def test_repair_backoff_caps_and_recovers():
+    db = ReplicatedFlowDatabase(replicas=2)
+    db.insert_flows(_batch(4))
+    faults.arm("replica.write:error@2")
+    db.insert_flows(_batch(5))
+    assert db.quarantined_indices() == [1]
+
+    # resync re-inserts through the stale replica's store insert —
+    # keep THAT failing to drive the repair backoff schedule
+    faults.arm("store.insert:error")
+    clock = [0.0]
+    loop = ReplicaRepairLoop(db, base_backoff=1.0, max_backoff=4.0,
+                             time_fn=lambda: clock[0])
+    assert loop.repair_once() == []           # attempt 1 fails
+    assert loop.failed_attempts == 1
+    clock[0] = 0.5
+    loop.repair_once()                        # inside backoff: skipped
+    assert loop.failed_attempts == 1
+    clock[0] = 1.5
+    assert loop.repair_once() == []           # attempt 2 (delay → 2s)
+    assert loop.failed_attempts == 2
+    clock[0] = 100.0
+    for _ in range(3):                        # drive to the cap
+        loop.repair_once()
+        clock[0] += 100.0
+    assert loop._next_attempt[1] - (clock[0] - 100.0) == 4.0  # capped
+
+    faults.disarm()
+    clock[0] += 100.0
+    assert loop.repair_once() == [1]          # heals once faults clear
+    assert loop.repairs == 1
+    assert db.quarantined_indices() == []
+
+
+def test_manual_down_is_not_auto_repaired():
+    db = ReplicatedFlowDatabase(replicas=2)
+    db.insert_flows(_batch(6))
+    db.set_replica_down(1)
+    assert ReplicaRepairLoop(db).repair_once() == []
+    assert db.membership()["down"] == [1]     # operator intent kept
+
+
+def test_manual_down_supersedes_quarantine():
+    """set_replica_down on an already-quarantined replica drops the
+    quarantine record: the repair loop must not override the
+    operator's explicit hold."""
+    faults.arm("replica.write:error@2")
+    db = ReplicatedFlowDatabase(replicas=2)
+    db.insert_flows(_batch(6))
+    assert db.quarantined_indices() == [1]
+    faults.disarm()
+    db.set_replica_down(1)                    # maintenance hold
+    assert db.quarantined_indices() == []
+    # the repair loop's gated re-admit refuses a non-quarantined
+    # replica (closes the sample-then-up race with a manual down)
+    assert db.repair_replica(1) is False
+    assert ReplicaRepairLoop(db).repair_once() == []
+    assert db.membership()["down"] == [1]
+
+
+def test_repair_loop_thread_heals_in_background():
+    faults.arm("replica.write:error@2")
+    db = ReplicatedFlowDatabase(replicas=2)
+    db.insert_flows(_batch(7))
+    assert db.quarantined_indices() == [1]
+    faults.disarm()
+    loop = ReplicaRepairLoop(db, interval=0.01)
+    loop.start()
+    try:
+        deadline = time.time() + 10
+        while db.quarantined_indices() and time.time() < deadline:
+            time.sleep(0.01)
+        assert db.quarantined_indices() == []
+    finally:
+        loop.stop()
+
+
+# -- checkpoint fault point --------------------------------------------
+
+
+def test_checkpoint_fault_then_recovery(tmp_path):
+    db = FlowDatabase()
+    db.insert_flows(_batch(9))
+    path = str(tmp_path / "snap.npz")
+    cp = Checkpointer(db, path)
+    faults.arm("checkpoint.save:error@1")
+    with pytest.raises(faults.FaultError):
+        cp.checkpoint()
+    assert cp.checkpoint() is True   # one-shot spent: next tick writes
+    assert os.path.exists(path)
+
+
+# -- job supervision: retries ------------------------------------------
+
+
+def test_thread_dispatch_transient_retry_then_succeed():
+    faults.arm("runner.exec:error@1")
+    ctl = JobController(_job_db(), workers=1, dispatch="thread",
+                        retry_backoff_base=0.01)
+    try:
+        rec = ctl.create(KIND_TAD, {"jobType": "EWMA", "retries": 2})
+        assert ctl.wait_all(timeout=120)
+        assert rec.state == STATE_COMPLETED, rec.error_msg
+        assert rec.attempts == 2
+        status = rec.status_dict()
+        assert status["attempts"] == 2
+        assert status["retries"] == 2
+        assert "FaultError" in status["lastFailureReason"]
+        assert ctl.tad_stats(rec.name)
+    finally:
+        ctl.shutdown()
+
+
+def test_subprocess_dispatch_transient_retry_then_succeed(
+        monkeypatch, tmp_path):
+    """First child exits 75 (EX_TEMPFAIL, the runner's injected-fault
+    marker), the retry exits 0 — the record completes with the attempt
+    count and last failure in status."""
+    ctl = JobController(_job_db(), workers=1, dispatch="subprocess",
+                        retry_backoff_base=0.01)
+    flag = tmp_path / "ran-once"
+    code = ("import os, sys\n"
+            "p = sys.argv[1]\n"
+            "if os.path.exists(p):\n"
+            "    sys.exit(0)\n"
+            "open(p, 'w').close()\n"
+            "sys.exit(75)\n")
+    monkeypatch.setattr(
+        ctl, "_runner_cmd",
+        lambda record, snap, prog: [sys.executable, "-c", code,
+                                    str(flag)])
+    try:
+        rec = ctl.create(KIND_TAD, {"jobType": "EWMA", "retries": 1})
+        assert ctl.wait_all(timeout=60)
+        assert rec.state == STATE_COMPLETED, rec.error_msg
+        assert rec.attempts == 2
+        assert "exit 75" in rec.status_dict()["lastFailureReason"]
+    finally:
+        ctl.shutdown()
+
+
+def test_retry_backoff_does_not_block_worker():
+    """The retry backoff runs on a timer, not in the calling worker:
+    _on_failure returns immediately (worker freed for healthy jobs)
+    and the timer re-queues the record after the delay."""
+    from theia_tpu.manager.jobs import TransientJobError
+
+    ctl = JobController(_job_db(), workers=0, dispatch="thread",
+                        retry_backoff_base=0.2)
+    try:
+        rec = ctl.create(KIND_TAD, {"jobType": "EWMA", "retries": 1})
+        ctl._queue.get_nowait()               # drain the create enqueue
+        rec.attempts = 1
+        t0 = time.monotonic()
+        ctl._on_failure(rec, TransientJobError("blip"))
+        assert time.monotonic() - t0 < 0.1    # returned pre-backoff
+        assert rec.state == STATE_SCHEDULED
+        assert ctl._queue.get(timeout=5) == rec.name  # timer requeued
+    finally:
+        ctl.shutdown()
+
+
+def test_retry_budget_exhausts_to_failed():
+    faults.arm("runner.exec:error")   # every attempt fails
+    ctl = JobController(_job_db(), workers=1, dispatch="thread",
+                        retry_backoff_base=0.01)
+    try:
+        rec = ctl.create(KIND_TAD, {"jobType": "EWMA", "retries": 2})
+        assert ctl.wait_all(timeout=60)
+        assert rec.state == STATE_FAILED
+        assert rec.attempts == 3              # 1 try + 2 retries
+        assert "FaultError" in rec.error_msg
+    finally:
+        ctl.shutdown()
+
+
+def test_terminal_spec_error_fails_fast_despite_retries():
+    ctl = JobController(_job_db(), workers=1, dispatch="thread",
+                        retry_backoff_base=0.01)
+    try:
+        rec = ctl.create(KIND_NPR, {"policyType": "bogus",
+                                    "retries": 3})
+        assert ctl.wait_all(timeout=30)
+        assert rec.state == STATE_FAILED
+        assert rec.attempts == 1              # no retry burned
+        assert "policyType" in rec.error_msg
+    finally:
+        ctl.shutdown()
+
+
+def test_supervision_defaults_from_env(monkeypatch):
+    monkeypatch.setenv("THEIA_JOB_RETRIES", "2")
+    monkeypatch.setenv("THEIA_JOB_DEADLINE", "7.5")
+    ctl = JobController(FlowDatabase(), workers=0)
+    try:
+        rec = ctl.create(KIND_TAD, {"jobType": "EWMA"})
+        assert rec.max_retries == 2
+        assert rec.deadline_seconds == 7.5
+        # spec keys override the controller defaults
+        rec2 = ctl.create(KIND_TAD, {"jobType": "EWMA", "retries": 0,
+                                     "deadlineSeconds": 0})
+        assert rec2.max_retries == 0
+        assert rec2.deadline_seconds == 0.0
+        with pytest.raises(ValueError):
+            ctl.create(KIND_TAD, {"jobType": "EWMA", "retries": -1})
+    finally:
+        ctl.shutdown()
+
+
+# -- job supervision: deadlines ----------------------------------------
+
+
+def test_fault_hung_runner_killed_at_deadline(monkeypatch):
+    """A REAL runner child, fault-hung via its inherited environment,
+    is killed at deadlineSeconds and the record fails with
+    DeadlineExceeded (terminal: no retry despite budget)."""
+    monkeypatch.setenv("THEIA_FAULTS", "runner.exec:hang")
+    monkeypatch.setenv("THEIA_FAULT_HANG_SECONDS", "120")
+    ctl = JobController(_job_db(), workers=1, dispatch="subprocess")
+    try:
+        rec = ctl.create(KIND_TAD, {"jobType": "EWMA",
+                                    "deadlineSeconds": 1.0,
+                                    "retries": 3})
+        assert ctl.wait_all(timeout=60)
+        assert rec.state == STATE_FAILED
+        assert "DeadlineExceeded" in rec.error_msg
+        assert rec.attempts == 1              # terminal, not retried
+        assert rec.runner_pid > 0
+        with pytest.raises(OSError):
+            os.kill(rec.runner_pid, 0)        # the child is gone
+    finally:
+        ctl.shutdown()
+
+
+# -- health surface -----------------------------------------------------
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_healthz_readyz_and_quarantine_visibility():
+    from theia_tpu.manager import TheiaManagerServer
+
+    db = ReplicatedFlowDatabase(replicas=2)
+    srv = TheiaManagerServer(db, port=0, workers=1)
+    srv.repairer.stop()   # deterministic: no background healing here
+    srv.start_background()
+    try:
+        code, doc = _get(srv.port, "/healthz")
+        assert code == 200
+        assert doc["status"] == "ok"
+        assert doc["replicas"]["replicas"] == 2
+        assert doc["jobs"]["queueDepth"] == 0
+        assert doc["ingest"]["shards"] >= 1
+        assert len(doc["ingest"]["perShard"]) == doc["ingest"]["shards"]
+        code, doc = _get(srv.port, "/readyz")
+        assert (code, doc["ready"]) == (200, True)
+
+        # injected fan-out failure → quarantine visible in /healthz
+        faults.arm("replica.write:error@2")
+        db.insert_flows(_batch(1))
+        faults.disarm()
+        code, doc = _get(srv.port, "/healthz")
+        assert code == 200                    # degraded ≠ down
+        assert doc["status"] == "degraded"
+        assert list(doc["replicas"]["quarantined"]) == ["1"]
+        code, _ = _get(srv.port, "/readyz")
+        assert code == 200                    # still serving
+
+        # all replicas out → not ready, and reads answer 503
+        db.set_replica_down(0)
+        code, doc = _get(srv.port, "/readyz")
+        assert (code, doc["ready"]) == (503, False)
+        code, doc = _get(
+            srv.port,
+            "/apis/stats.theia.antrea.io/v1alpha1/clickhouse")
+        assert code == 503                    # AllReplicasDown → 503
+        assert "down" in doc["message"]
+        code, doc = _get(srv.port, "/healthz")
+        assert code == 200                    # liveness stays up
+        db.set_replica_up(0, resync=False)
+    finally:
+        srv.shutdown()
+
+
+def test_healthz_armed_faults_visible():
+    from theia_tpu.manager import TheiaManagerServer
+
+    srv = TheiaManagerServer(FlowDatabase(), port=0, workers=1)
+    srv.start_background()
+    try:
+        faults.arm("checkpoint.save:error")
+        code, doc = _get(srv.port, "/healthz")
+        assert code == 200
+        assert doc["faults"]["armed"] == ["checkpoint.save"]
+        assert "replicas" not in doc          # unreplicated store
+    finally:
+        srv.shutdown()
+
+
+def test_manager_repair_loop_heals_quarantined_replica():
+    """End to end through the manager: the server's own repair loop
+    returns a quarantined replica to service."""
+    from theia_tpu.manager import TheiaManagerServer
+
+    db = ReplicatedFlowDatabase(replicas=2)
+    srv = TheiaManagerServer(db, port=0, workers=1)
+    # swap in a fast-interval loop (the default 2s pace would make
+    # this test sleep)
+    srv.repairer.stop()
+    srv.repairer = ReplicaRepairLoop(db, interval=0.01)
+    srv.repairer.start()
+    try:
+        faults.arm("replica.write:error@2")
+        db.insert_flows(_batch(2))
+        faults.disarm()
+        assert db.quarantined_indices() == [1]
+        deadline = time.time() + 10
+        while db.quarantined_indices() and time.time() < deadline:
+            time.sleep(0.01)
+        assert db.quarantined_indices() == []
+        a, b = (r.flows.scan() for r in db.replicas)
+        assert len(a) == len(b) == 60
+    finally:
+        srv.shutdown()
+
+
+# -- reconciler backoff -------------------------------------------------
+
+
+def test_reconciler_backoff_on_consecutive_failures(tmp_path):
+    from theia_tpu.manager.reconciler import DeclarativeReconciler
+
+    ctl = JobController(FlowDatabase(), workers=0)
+    rec = DeclarativeReconciler(ctl, str(tmp_path), interval=0.01)
+    rec.backoff_cap = 0.05
+    faults.arm("reconciler.pass:error")
+    rec.start()
+    try:
+        deadline = time.time() + 10
+        while rec.consecutive_failures < 3 and time.time() < deadline:
+            time.sleep(0.01)
+        assert rec.consecutive_failures >= 3
+        assert rec.interval < rec.current_delay <= rec.backoff_cap
+
+        faults.disarm()             # directory "recovers"
+        deadline = time.time() + 10
+        while rec.consecutive_failures and time.time() < deadline:
+            time.sleep(0.01)
+        assert rec.consecutive_failures == 0
+        assert rec.current_delay == rec.interval
+    finally:
+        rec.stop()
+        ctl.shutdown()
+
+
+# -- CLI poll retry -----------------------------------------------------
+
+
+def test_cli_poll_retries_transient_errors(monkeypatch):
+    from theia_tpu.cli import __main__ as cli
+
+    calls = {"n": 0}
+
+    def fake_request(addr, method, path, body=None):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise cli.APIConnectionError(
+                "error: cannot reach theia-manager at x: refused")
+        return {"status": {"state": "COMPLETED"}}
+
+    sleeps = []
+    monkeypatch.setattr(cli, "_request", fake_request)
+    monkeypatch.setattr(cli.time, "sleep", lambda s: sleeps.append(s))
+    doc = cli._wait_for_job("http://x", cli.TAD_RESOURCE, "tad-x")
+    assert doc["status"]["state"] == "COMPLETED"
+    assert calls["n"] == 3
+    assert sleeps == [1.0, 2.0]   # capped exponential backoff
+
+
+def test_cli_poll_gives_up_at_deadline(monkeypatch):
+    from theia_tpu.cli import __main__ as cli
+
+    def always_down(addr, method, path, body=None):
+        raise cli.APIConnectionError("error: cannot reach manager")
+
+    monkeypatch.setattr(cli, "_request", always_down)
+    monkeypatch.setattr(cli.time, "sleep", lambda s: None)
+    with pytest.raises(cli.APIConnectionError):
+        cli._poll_request("http://x", "/p", deadline=time.time() - 1)
+
+
+def test_cli_tls_failure_is_not_retried(monkeypatch):
+    """A TLS verification failure is permanent: it must classify as a
+    plain APIError (fail fast), not the retryable connection class."""
+    import ssl
+    import urllib.request
+
+    from theia_tpu.cli import __main__ as cli
+
+    def boom(*a, **kw):
+        raise urllib.error.URLError(
+            ssl.SSLCertVerificationError("certificate verify failed"))
+
+    monkeypatch.setattr(urllib.request, "urlopen", boom)
+    with pytest.raises(cli.APIError) as ei:
+        cli._request("https://x", "GET", "/p")
+    assert not isinstance(ei.value, cli.APIConnectionError)
+
+
+def test_cli_non_transient_http_error_fails_fast(monkeypatch):
+    from theia_tpu.cli import __main__ as cli
+
+    def bad_request(addr, method, path, body=None):
+        raise cli.APIError("error: 400 from manager: nope")
+
+    monkeypatch.setattr(cli, "_request", bad_request)
+    with pytest.raises(cli.APIError):
+        cli._poll_request("http://x", "/p",
+                          deadline=time.time() + 3600)

@@ -24,6 +24,7 @@ import argparse
 import datetime
 import json
 import os
+import ssl
 import sys
 import time
 import urllib.error
@@ -38,6 +39,7 @@ from ..utils import (
     get_manager_addr,
     validate_k8s_quantity,
 )
+from ..utils.backoff import capped_backoff
 
 DEFAULT_ADDR = "http://127.0.0.1:11347"
 GROUP = "/apis/intelligence.theia.antrea.io/v1alpha1"
@@ -57,6 +59,12 @@ class APIError(SystemExit):
     pass
 
 
+class APIConnectionError(APIError):
+    """Transient transport-level failure (connection refused/reset,
+    timeout, HTTP 503): worth retrying inside a poll loop, fatal
+    everywhere a human is waiting on one answer."""
+
+
 _CA_CERT = ""
 _TOKEN = ""
 
@@ -64,7 +72,6 @@ _TOKEN = ""
 def _url_context():
     if not _CA_CERT:
         return None
-    import ssl
     return ssl.create_default_context(cafile=_CA_CERT)
 
 
@@ -92,11 +99,36 @@ def _request(addr: str, method: str, path: str,
             detail = json.loads(detail).get("message", detail)
         except Exception:
             pass
-        raise APIError(f"error: {e.code} from manager: {detail}")
+        cls = APIConnectionError if e.code == 503 else APIError
+        raise cls(f"error: {e.code} from manager: {detail}")
     except urllib.error.URLError as e:
-        raise APIError(
+        # covers socket.timeout too (URLError wraps it) — but a TLS
+        # failure (bad CA, hostname mismatch) is permanent: retrying
+        # it for the whole poll window would bury the real reason
+        cls = (APIError if isinstance(e.reason, ssl.SSLError)
+               else APIConnectionError)
+        raise cls(
             f"error: cannot reach theia-manager at {addr}: {e.reason}")
     return json.loads(raw) if raw else {}
+
+
+def _poll_request(addr: str, path: str, deadline: float) -> Dict:
+    """GET with transient retry: a poll loop that has been waiting on
+    a job for minutes must not die to a single connection blip or a
+    503 (manager restarting, replicas resyncing). Capped exponential
+    backoff, bounded by the caller's overall poll deadline."""
+    attempt = 0
+    while True:
+        try:
+            return _request(addr, "GET", path)
+        except APIConnectionError as e:
+            attempt += 1
+            backoff = capped_backoff(1.0, 30.0, attempt)
+            if time.time() + backoff > deadline:
+                raise
+            print(f"warning: {e}; retrying in {backoff:.0f}s",
+                  file=sys.stderr)
+            time.sleep(backoff)
 
 
 def _parse_time_arg(value: str, flag: str) -> Optional[int]:
@@ -113,7 +145,8 @@ def _parse_time_arg(value: str, flag: str) -> Optional[int]:
 def _wait_for_job(addr: str, resource: str, name: str) -> Dict:
     deadline = time.time() + POLL_TIMEOUT
     while time.time() < deadline:
-        doc = _request(addr, "GET", f"{GROUP}/{resource}/{name}")
+        doc = _poll_request(addr, f"{GROUP}/{resource}/{name}",
+                            deadline)
         state = (doc.get("status") or {}).get("state", "")
         if state in ("COMPLETED", "FAILED"):
             return doc
@@ -517,7 +550,7 @@ def _poll_and_download(addr: str, path: str, wait_s: float,
     Returns the byte count."""
     deadline = time.time() + wait_s
     while time.time() < deadline:
-        doc = _request(addr, "GET", path)
+        doc = _poll_request(addr, path, deadline)
         status = doc.get("status")
         if status == "collected":
             break

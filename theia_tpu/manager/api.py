@@ -61,7 +61,9 @@ from .jobs import (
 )
 from .stats import StatsProvider
 from .. import __version__
+from ..store import AllReplicasDownError, ReplicatedFlowDatabase
 from ..utils import dump_logs, get_logger
+from ..utils import faults as _faults
 
 logger = get_logger("apiserver")
 
@@ -298,6 +300,9 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
             self._get()
         except AuthError as e:
             self._send_auth_error(e)
+        except AllReplicasDownError as e:
+            # "retry later", not "server bug": every store copy is out
+            self._send_error_json(503, str(e))
         except KeyError:
             self._send_error_json(404, f"not found: {self.path}")
         except ValueError as e:  # malformed query params are the
@@ -314,8 +319,9 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
             self._send_auth_error(e)
         except DuplicateJobError as e:
             self._send_error_json(409, str(e))
-        except StreamCapacityError as e:
-            # retryable capacity condition, not a client payload error
+        except (StreamCapacityError, AllReplicasDownError) as e:
+            # retryable capacity/availability condition, not a client
+            # payload error
             self._send_error_json(503, str(e))
         except KeyError:
             self._send_error_json(404, f"not found: {self.path}")
@@ -330,6 +336,8 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
             self._delete()
         except AuthError as e:
             self._send_auth_error(e)
+        except AllReplicasDownError as e:
+            self._send_error_json(503, str(e))
         except KeyError:
             self._send_error_json(404, f"not found: {self.path}")
         except Exception as e:
@@ -351,7 +359,11 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
                  "detectorShards": self.ingest.n_shards})
             return
         if parts == ("healthz",):
-            self._send_json({"status": "ok"})
+            self._send_json(self._health_doc())
+            return
+        if parts == ("readyz",):
+            doc, code = self._ready_doc()
+            self._send_json(doc, code)
             return
         if parts == ("version",):
             self._send_json({"version": __version__})
@@ -369,6 +381,43 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
             self._get_dashboard(parts)
             return
         raise KeyError(self.path)
+
+    def _health_doc(self) -> Dict[str, object]:
+        """Liveness + degradation surface (no decoded identities, so it
+        stays on the open read path): `status` is "ok" while every
+        replica serves and "degraded" when the store is down a copy
+        but still serving — distinguishable from down, which /readyz
+        reports. Covers replica membership/quarantine, job queue
+        depth, ingest detector-shard liveness, and any armed fault
+        sites (so an operator can see a fault drill is running)."""
+        doc: Dict[str, object] = {
+            "status": "ok",
+            "jobs": self.controller.health(),
+        }
+        if self.ingest is not None:
+            doc["ingest"] = self.ingest.shard_liveness()
+        db = self.controller.db
+        if isinstance(db, ReplicatedFlowDatabase):
+            m = db.membership()
+            doc["replicas"] = m
+            if m["down"] or m["quarantined"]:
+                doc["status"] = "degraded"
+        armed = _faults.armed_sites()
+        if armed:
+            doc["faults"] = {"armed": armed}
+        return doc
+
+    def _ready_doc(self) -> Tuple[Dict[str, object], int]:
+        """Readiness: can this manager serve reads/writes at all? All
+        replicas down → 503 (take it out of rotation); degraded but
+        serving → 200 (healthz carries the detail)."""
+        db = self.controller.db
+        try:
+            if isinstance(db, ReplicatedFlowDatabase):
+                db.live()
+        except AllReplicasDownError as e:
+            return {"ready": False, "reason": str(e)}, 503
+        return {"ready": True}, 200
 
     def _get_dashboard(self, parts) -> None:
         """/dashboards/[<name>] → HTML page;
@@ -607,6 +656,7 @@ class TheiaManagerServer:
         self.profiles = ProfileManager()
         self.auth_token = resolve_auth_token(auth_token,
                                              auth_token_file)
+        self.repairer = None
 
         handler = type("BoundHandler", (ManagerAPIHandler,), {
             "controller": self.controller,
@@ -632,6 +682,16 @@ class TheiaManagerServer:
             self.httpd.ssl_context = ctx
             self.ca_cert_path = ca
         self.port = self.httpd.server_address[1]
+        # Replicated store → background self-healing: resync and
+        # re-admit replicas auto-quarantined by failed fan-out writes
+        # (manual set_replica_down marks are left alone). Started
+        # last, after the socket bind and TLS setup can no longer
+        # raise — a constructor failure must not leak a live repair
+        # thread nothing can stop.
+        if isinstance(db, ReplicatedFlowDatabase):
+            from ..store import ReplicaRepairLoop
+            self.repairer = ReplicaRepairLoop(db)
+            self.repairer.start()
         self._thread: Optional[threading.Thread] = None
         self._serving = False
 
@@ -652,6 +712,8 @@ class TheiaManagerServer:
         if self._serving:
             self.httpd.shutdown()
         self.httpd.server_close()
+        if self.repairer is not None:
+            self.repairer.stop()
         self.ingest.close()
         self.controller.shutdown()
         if self._thread:

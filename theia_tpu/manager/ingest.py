@@ -460,6 +460,28 @@ class IngestManager:
             "totalVolume": float(self._shard_totals.sum()),
         }
 
+    def shard_liveness(self) -> Dict[str, object]:
+        """Health-surface view of the detector shards: per-shard series
+        occupancy plus a non-blocking lock probe (`busy` — True means a
+        request held the shard's lock at sample time; a shard that is
+        busy on EVERY probe is wedged)."""
+        per_shard = []
+        for s in self.shards:
+            acquired = s.lock.acquire(blocking=False)
+            if acquired:
+                s.lock.release()
+            per_shard.append({
+                "shard": s.index,
+                "busy": not acquired,
+                "series": int(s.streaming.n_series),
+            })
+        return {
+            "shards": self.n_shards,
+            "streams": len(self._streams),
+            "rowsIngested": self.rows_ingested,
+            "perShard": per_shard,
+        }
+
     def push_alert(self, alert: Dict[str, object]) -> None:
         """Publish an externally produced alert (e.g. a completed
         spatial job's noise flows) onto the ring."""

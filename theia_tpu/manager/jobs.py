@@ -43,6 +43,7 @@ import threading
 import time
 import traceback
 import uuid
+import zlib
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -50,11 +51,16 @@ import numpy as np
 from ..analytics import (TadQuerySpec, run_drop_detection, run_npr,
                          run_pattern_mining, run_spatial, run_tad)
 from ..runner.__main__ import TIME_FORMAT as RUNNER_TIME_FORMAT
+from ..runner.__main__ import TRANSIENT_EXIT_CODE
 from ..runner.progress import (DD_STAGES, FPM_STAGES, NPR_STAGES,
                                SPATIAL_STAGES, TAD_STAGES,
                                FileProgress, JobProgress)
 from ..store import FlowDatabase
 from ..utils import get_logger, parse_job_name, validate_policy_type
+from ..utils.backoff import capped_backoff
+from ..utils.env import env_float, env_int
+from ..utils.faults import FaultError
+from ..utils.faults import fire as _fire_fault
 
 logger = get_logger("jobs")
 
@@ -93,6 +99,18 @@ class DuplicateJobError(Exception):
     """A job with this name already exists (→ HTTP 409)."""
 
 
+class DeadlineExceeded(Exception):
+    """The runner child outlived its deadlineSeconds and was killed
+    (the Spark Operator's activeDeadlineSeconds role). Terminal: the
+    next attempt would hang the same way."""
+
+
+class TransientJobError(Exception):
+    """A failure classification worth retrying — the runner died to a
+    signal or fault-injected I/O, never a spec error (those fail
+    fast)."""
+
+
 def _validate_max_len(spec) -> int:
     """Pattern-mining maxLen ∈ {1,2,3}, enforced identically in both
     dispatch modes (the runner's argparse would reject 4+ anyway —
@@ -123,6 +141,10 @@ class JobRecord:
     progress: Optional[object] = None   # JobProgress | FileProgress
     runner_pid: int = 0                 # subprocess dispatch only
     runner_log_tail: str = ""           # child stderr tail (bundle)
+    max_retries: int = 0                # spec `retries` / controller dflt
+    deadline_seconds: float = 0.0       # spec `deadlineSeconds`; 0 = off
+    attempts: int = 0                   # completed execution attempts
+    last_failure: str = ""              # most recent attempt's failure
 
     @property
     def job_id(self) -> str:
@@ -142,6 +164,9 @@ class JobRecord:
             "errorMsg": self.error_msg,
             "startTime": self.start_time,
             "endTime": self.end_time,
+            "attempts": self.attempts,
+            "retries": self.max_retries,
+            "lastFailureReason": self.last_failure,
         }
 
 
@@ -150,11 +175,25 @@ class JobController:
 
     def __init__(self, db: FlowDatabase, workers: int = 2,
                  dispatch: str = "thread",
-                 alert_sink=None) -> None:
+                 alert_sink=None,
+                 retries: Optional[int] = None,
+                 deadline_seconds: Optional[float] = None,
+                 retry_backoff_base: float = 0.5,
+                 retry_backoff_cap: float = 30.0) -> None:
         if dispatch not in ("thread", "subprocess"):
             raise ValueError(f"unknown dispatch mode {dispatch!r}")
         self.db = db
         self.dispatch = dispatch
+        # Supervision defaults (per-job spec keys override): retry
+        # budget for TRANSIENT failures and the runner-child deadline —
+        # the Spark Operator's restartPolicy / activeDeadlineSeconds.
+        self.default_retries = (env_int("THEIA_JOB_RETRIES", 0)
+                                if retries is None else int(retries))
+        self.default_deadline = (
+            env_float("THEIA_JOB_DEADLINE", 0.0)
+            if deadline_seconds is None else float(deadline_seconds))
+        self.retry_backoff_base = retry_backoff_base
+        self.retry_backoff_cap = retry_backoff_cap
         #: optional callable(dict) — completed spatial jobs push their
         #: noise flows here (the manager wires the ingest alert ring)
         self.alert_sink = alert_sink
@@ -163,6 +202,9 @@ class JobController:
         self._device_lock = threading.Lock()
         self._records: Dict[str, JobRecord] = {}
         self._lock = threading.Lock()
+        #: job name → (Timer, record) for retries waiting out their
+        #: backoff; cancelled (and the records failed) on shutdown
+        self._retry_timers: Dict[str, tuple] = {}
         self._queue: "queue.Queue[str]" = queue.Queue()
         self._stop = threading.Event()
         self._threads = [
@@ -175,13 +217,38 @@ class JobController:
 
     # -- CRUD ------------------------------------------------------------
 
+    def _spec_retries(self, spec: Dict[str, object]) -> int:
+        raw = spec.get("retries")
+        n = self.default_retries if raw is None else int(raw)
+        if n < 0:
+            raise ValueError(f"retries must be >= 0, got {n}")
+        return n
+
+    def _spec_deadline(self, spec: Dict[str, object]) -> float:
+        raw = spec.get("deadlineSeconds")
+        d = self.default_deadline if raw is None else float(raw)
+        if d < 0:
+            raise ValueError(f"deadlineSeconds must be >= 0, got {d}")
+        return d
+
     def create(self, kind: str, spec: Dict[str, object],
                name: Optional[str] = None) -> JobRecord:
         if name is None:
             name = _NAME_PREFIX[kind] + str(uuid.uuid4())
         job_id_from_name(kind, name)  # validate
         record = JobRecord(name=name, kind=kind, spec=dict(spec),
-                           state=STATE_SCHEDULED)
+                           state=STATE_SCHEDULED,
+                           max_retries=self._spec_retries(spec),
+                           deadline_seconds=self._spec_deadline(spec))
+        if record.deadline_seconds and self.dispatch == "thread":
+            # an in-process job shares our interpreter; Python offers
+            # no safe thread kill, so only subprocess dispatch can
+            # enforce the deadline — say so instead of silently not
+            logger.error("job %s: deadlineSeconds=%g is not "
+                         "enforceable under thread dispatch (a hung "
+                         "in-process job cannot be killed); use "
+                         "--dispatch subprocess for deadline "
+                         "supervision", name, record.deadline_seconds)
         with self._lock:
             if name in self._records:
                 raise DuplicateJobError(f"job {name} already exists")
@@ -279,11 +346,73 @@ class JobController:
             finally:
                 self._queue.task_done()
 
+    @staticmethod
+    def _is_transient(e: BaseException) -> bool:
+        """Retry-worthy failure classes: the runner died to a signal or
+        injected I/O fault. Spec/validation errors and deadline kills
+        stay terminal (they would fail identically on every retry)."""
+        return isinstance(e, (TransientJobError, FaultError))
+
+    def _retry_delay(self, record: JobRecord) -> float:
+        """Exponential backoff with DETERMINISTIC jitter (crc32 of
+        name+attempt → a [1.0, 1.5) factor): a retry herd spreads out,
+        and a test replaying the same job sees the same schedule. The
+        cap bounds the base schedule and the jitter rides on top —
+        clamping after jitter would re-synchronize every capped-out
+        retry to exactly the cap, recreating the herd."""
+        frac = (zlib.crc32(
+            f"{record.name}:{record.attempts}".encode()) % 1000) / 1000.0
+        return capped_backoff(self.retry_backoff_base,
+                              self.retry_backoff_cap,
+                              record.attempts) * (1.0 + 0.5 * frac)
+
+    def _on_failure(self, record: JobRecord, e: BaseException) -> None:
+        """FAILED — or, for a transient failure with retry budget left,
+        re-queue after a backoff. The backoff runs on a timer, not in
+        the worker (a worker parked in sleep would starve healthy
+        SCHEDULED jobs); the record stays SCHEDULED through the delay,
+        so wait_all() keeps waiting on it."""
+        msg = f"{type(e).__name__}: {e}"
+        record.last_failure = msg
+        retryable = (self._is_transient(e)
+                     and record.attempts <= record.max_retries
+                     and not self._deleted(record)
+                     and not self._stop.is_set())
+        if retryable:
+            delay = self._retry_delay(record)
+            record.state = STATE_SCHEDULED
+            logger.error("job %s attempt %d/%d failed (%s); retrying "
+                         "in %.2fs", record.name, record.attempts,
+                         record.max_retries + 1, msg, delay)
+
+            def _requeue() -> None:
+                with self._lock:
+                    self._retry_timers.pop(record.name, None)
+                if self._stop.is_set() or self._deleted(record):
+                    record.state = STATE_FAILED
+                    record.error_msg = msg
+                else:
+                    self._queue.put(record.name)
+
+            timer = threading.Timer(delay, _requeue)
+            timer.daemon = True
+            with self._lock:
+                self._retry_timers[record.name] = (timer, record)
+            timer.start()
+            return
+        record.state = STATE_FAILED
+        record.error_msg = msg
+        if record.progress:
+            record.progress.fail(msg)
+        logger.error("job %s failed: %s\n%s", record.name, msg,
+                     traceback.format_exc())
+
     def _run(self, record: JobRecord) -> None:
         record.state = STATE_RUNNING
+        record.attempts += 1
         record.start_time = time.time()
-        logger.v(1).info("job %s started (%s)", record.name,
-                         self.dispatch)
+        logger.v(1).info("job %s started (%s, attempt %d)", record.name,
+                         self.dispatch, record.attempts)
         try:
             if self.dispatch == "subprocess":
                 self._run_subprocess(record)
@@ -300,13 +429,8 @@ class JobController:
                 except Exception:
                     logger.error("job %s: alert push failed\n%s",
                                  record.name, traceback.format_exc())
-        except Exception as e:   # job failure → FAILED CR status
-            record.state = STATE_FAILED
-            record.error_msg = f"{type(e).__name__}: {e}"
-            if record.progress:
-                record.progress.fail(record.error_msg)
-            logger.error("job %s failed: %s\n%s", record.name,
-                         record.error_msg, traceback.format_exc())
+        except Exception as e:   # job failure → FAILED CR or retry
+            self._on_failure(record, e)
         finally:
             record.end_time = time.time()
             # If the CR was deleted while the job ran, its result rows
@@ -356,6 +480,9 @@ class JobController:
             })
 
     def _run_inprocess(self, record: JobRecord) -> None:
+        # same site the runner child fires in subprocess dispatch, so
+        # a transient execution fault is injectable in both modes
+        _fire_fault("runner.exec", job=record.name)
         spec = record.spec
         if record.kind == KIND_FPM:
             from ..analytics.itemsets import DEFAULT_COLUMNS
@@ -554,9 +681,13 @@ class JobController:
             # fills at ~64 KiB and deadlocks a chatty child against
             # our wait() loop.
             err_path = os.path.join(workdir, "stderr.log")
+            _fire_fault("runner.spawn", job=record.name)
+            deadline_s = record.deadline_seconds
+            deadline_hit = False
             with open(os.path.join(workdir, "stdout.log"), "wb") as out_f, \
                     open(err_path, "wb") as err_f, \
                     self._device_lock:
+                t_spawn = time.monotonic()
                 proc = subprocess.Popen(
                     cmd, stdout=out_f, stderr=err_f, env=env,
                     cwd=workdir)
@@ -575,6 +706,15 @@ class JobController:
                                 # accelerator claimed past the
                                 # manager's death)
                                 proc.kill()
+                            elif (deadline_s and not deadline_hit
+                                  and time.monotonic() - t_spawn
+                                  > deadline_s):
+                                # a hung child would otherwise hold
+                                # this worker AND the device lock
+                                # forever (the Spark Operator's
+                                # activeDeadlineSeconds kill)
+                                deadline_hit = True
+                                proc.kill()
                 except BaseException:
                     proc.kill()
                     proc.wait()
@@ -591,14 +731,27 @@ class JobController:
                         errors="replace")
             except OSError:
                 pass
+            if deadline_hit:
+                raise DeadlineExceeded(
+                    f"runner exceeded deadlineSeconds={deadline_s:g} "
+                    f"and was killed")
             if proc.returncode != 0:
                 tail = " | ".join(record.runner_log_tail
                                   .strip().splitlines()[-5:])
-                sig = (f"killed by signal {-proc.returncode}"
-                       if proc.returncode < 0
-                       else f"exited {proc.returncode}")
+                suffix = f": {tail}" if tail else ""
+                if proc.returncode < 0:
+                    # signal deaths (OOM kill, node reaper) are the
+                    # transient class the reference's Spark Operator
+                    # restartPolicy retries
+                    raise TransientJobError(
+                        f"runner killed by signal {-proc.returncode}"
+                        + suffix)
+                if proc.returncode == TRANSIENT_EXIT_CODE:
+                    raise TransientJobError(
+                        f"runner transient failure (exit "
+                        f"{TRANSIENT_EXIT_CODE})" + suffix)
                 raise RuntimeError(
-                    f"runner {sig}" + (f": {tail}" if tail else ""))
+                    f"runner exited {proc.returncode}" + suffix)
             self._merge_results(record, snap + ".results.npz")
         finally:
             shutil.rmtree(workdir, ignore_errors=True)
@@ -625,6 +778,31 @@ class JobController:
             if len(rows):
                 dst.insert(rows)
 
+    def health(self) -> Dict[str, object]:
+        """Operator health view (served by GET /healthz): queue depth
+        plus record counts by state, with in-backoff retries broken
+        out (they are SCHEDULED records that already failed once)."""
+        with self._lock:
+            records = list(self._records.values())
+        states = {STATE_SCHEDULED: 0, STATE_RUNNING: 0,
+                  STATE_COMPLETED: 0, STATE_FAILED: 0}
+        retrying = 0
+        for r in records:
+            states[r.state] = states.get(r.state, 0) + 1
+            if r.state == STATE_SCHEDULED and r.attempts:
+                retrying += 1
+        return {
+            "queueDepth": self._queue.qsize(),
+            "records": len(records),
+            "scheduled": states[STATE_SCHEDULED],
+            "running": states[STATE_RUNNING],
+            "completed": states[STATE_COMPLETED],
+            "failed": states[STATE_FAILED],
+            "retrying": retrying,
+            "workers": len(self._threads),
+            "dispatch": self.dispatch,
+        }
+
     def wait_all(self, timeout: float = 60.0) -> bool:
         """Test/CLI helper: block until the queue drains and no job is
         RUNNING."""
@@ -640,6 +818,16 @@ class JobController:
 
     def shutdown(self) -> None:
         self._stop.set()
+        # Retries parked on a backoff timer will never run now: cancel
+        # the timers and fail their records with the last failure (the
+        # same terminal state the retry would reach under stop).
+        with self._lock:
+            pending = list(self._retry_timers.values())
+            self._retry_timers.clear()
+        for timer, record in pending:
+            timer.cancel()
+            record.state = STATE_FAILED
+            record.error_msg = record.last_failure
         # Generous join: a subprocess worker needs time to kill its
         # child (stop flag is polled every 0.2s in the wait loop) and
         # run its cleanup (workdir rmtree) — a 2s give-up would orphan

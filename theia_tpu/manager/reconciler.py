@@ -34,6 +34,8 @@ import threading
 from typing import Dict, Optional, Tuple
 
 from ..utils import get_logger
+from ..utils.backoff import capped_backoff
+from ..utils.faults import fire as _fire_fault
 from .jobs import (KIND_DD, KIND_FPM, KIND_NPR, KIND_SPATIAL,
                    KIND_TAD, STATE_COMPLETED, STATE_FAILED,
                    DuplicateJobError)
@@ -74,6 +76,11 @@ class DeclarativeReconciler:
         #: CRs whose status file already records a terminal state —
         #: skipped (and logged) once, not re-read every pass
         self._terminal: Dict[str, str] = {}
+        #: cap for the consecutive-failure backoff (injectable so
+        #: tests exercise the schedule without long sleeps)
+        self.backoff_cap = 60.0
+        self.consecutive_failures = 0
+        self.current_delay = interval
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         os.makedirs(directory, exist_ok=True)
@@ -91,11 +98,28 @@ class DeclarativeReconciler:
             self._thread.join(timeout=15)
 
     def _loop(self) -> None:
-        while not self._stop.wait(self.interval):
+        # Capped exponential backoff on CONSECUTIVE pass failures: a
+        # broken directory (unmountable volume, permission flip) is
+        # probed, not hammered every 2s; the first clean pass resets
+        # to the level-triggered cadence.
+        while not self._stop.wait(self.current_delay):
             try:
                 self.reconcile_once()
             except Exception as e:   # keep reconciling after bad input
-                logger.error("reconcile pass failed: %s", e)
+                self.consecutive_failures += 1
+                self.current_delay = capped_backoff(
+                    self.interval * 2, self.backoff_cap,
+                    self.consecutive_failures)
+                logger.error("reconcile pass failed (%d consecutive): "
+                             "%s; backing off %.1fs",
+                             self.consecutive_failures, e,
+                             self.current_delay)
+            else:
+                if self.consecutive_failures:
+                    logger.info("reconcile recovered after %d failed "
+                                "passes", self.consecutive_failures)
+                self.consecutive_failures = 0
+                self.current_delay = self.interval
 
     # -- one pass ---------------------------------------------------------
 
@@ -148,6 +172,7 @@ class DeclarativeReconciler:
         return out
 
     def reconcile_once(self) -> Dict[str, int]:
+        _fire_fault("reconciler.pass", directory=self.directory)
         desired = self._desired()
         current = {r.name: r for r in self.controller.list()}
         created = deleted = 0

@@ -36,6 +36,11 @@ from ..utils import AGG_FLOWS, TAD_ALGOS
 
 TIME_FORMAT = "%Y-%m-%d %H:%M:%S"
 
+#: exit status for an injected/transient I/O failure (EX_TEMPFAIL):
+#: the controller classifies it retry-worthy, unlike a spec error's
+#: generic non-zero exit
+TRANSIENT_EXIT_CODE = 75
+
 
 def parse_time(value: Optional[str]) -> Optional[int]:
     if not value:
@@ -313,6 +318,19 @@ def main(argv=None) -> None:
         import jax
         jax.config.update("jax_platforms", plats)
     args = build_parser().parse_args(argv)
+    # Fault point shared with thread dispatch: THEIA_FAULTS reaches
+    # this child through the env the controller spawned it with. An
+    # injected error exits TRANSIENT_EXIT_CODE (the controller's
+    # retry classification); an injected hang sits here until the
+    # controller's deadline kill.
+    import sys
+
+    from ..utils import faults
+    try:
+        faults.fire("runner.exec", job=args.job)
+    except faults.FaultError as e:
+        print(str(e), file=sys.stderr)
+        raise SystemExit(TRANSIENT_EXIT_CODE)
     runners = {"tad": run_tad_job, "npr": run_npr_job,
                "dropdetection": run_dd_job,
                "patterns": run_patterns_job,

@@ -2,7 +2,8 @@
 
 from .checkpoint import Checkpointer
 from .flow_store import FlowDatabase, RetentionMonitor, Table
-from .replicated import AllReplicasDownError, ReplicatedFlowDatabase
+from .replicated import (AllReplicasDownError, ReplicaRepairLoop,
+                         ReplicatedFlowDatabase)
 from .sharded import (DistributedTable, DistributedView,
                       ShardedFlowDatabase)
 from .views import (MATERIALIZED_VIEWS, ViewSpec, ViewTable, group_reduce,
@@ -10,7 +11,8 @@ from .views import (MATERIALIZED_VIEWS, ViewSpec, ViewTable, group_reduce,
 
 __all__ = [
     "AllReplicasDownError", "Checkpointer", "FlowDatabase",
-    "ReplicatedFlowDatabase", "RetentionMonitor", "Table",
+    "ReplicaRepairLoop", "ReplicatedFlowDatabase",
+    "RetentionMonitor", "Table",
     "DistributedTable", "DistributedView", "ShardedFlowDatabase",
     "MATERIALIZED_VIEWS", "ViewSpec", "ViewTable", "group_reduce", "group_sum",
 ]

@@ -25,6 +25,7 @@ import time
 from typing import Optional, Tuple
 
 from ..utils import get_logger
+from ..utils.faults import fire as _fire_fault
 
 logger = get_logger("checkpoint")
 
@@ -127,6 +128,7 @@ class Checkpointer:
         fp = self._fingerprint()
         if fp == self._last_fingerprint:
             return False
+        _fire_fault("checkpoint.save", path=self.path)
         self.db.save(self.path, compress=self.compress)
         self._last_fingerprint = fp
         self.checkpoints_written += 1

@@ -47,6 +47,7 @@ RESULT_TABLE_SCHEMAS = (
     ("flowpatterns", FLOWPATTERNS_SCHEMA),
     ("spatialnoise", SPATIALNOISE_SCHEMA),
 )
+from ..utils.faults import fire as _fire_fault
 from ..utils.pool import get_pool
 from .views import MATERIALIZED_VIEWS, ViewTable
 
@@ -322,6 +323,9 @@ class FlowDatabase:
     def insert_flows(self, batch: ColumnarBatch,
                      now: Optional[int] = None) -> int:
         """Insert a flow batch; fan out to materialized views; evict TTL."""
+        # fires once per PHYSICAL store: once per replica in a
+        # replicated fan-out, once per resync re-insert
+        _fire_fault("store.insert", table="flows")
         adopted = self.flows.insert(batch)
         if adopted is None:
             return 0

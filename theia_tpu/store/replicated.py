@@ -20,14 +20,32 @@ Result tables are wrapped so analytics jobs and the controller's GC
 mutate every live replica; their deletes are value-based
 (Table.delete_ids), because replicas route rows to different physical
 orders and a positional mask would corrupt them.
+
+Failure domains: a replica that raises during a fan-out write is
+auto-QUARANTINED (marked down with the failure recorded) while the
+write succeeds on the survivors — the divergence window is closed the
+moment it opens, instead of replicas silently drifting apart. A write
+that fails on EVERY live replica quarantines nobody and re-raises the
+first error: uniform failure means the request was bad (no replica
+took it, so no divergence), and a ValueError must keep reaching the
+client as a 400, not a replica incident. ReplicaRepairLoop resyncs
+and re-admits quarantined replicas in the background (capped
+exponential backoff per replica); replicas downed MANUALLY via
+set_replica_down are operator intent and are never re-admitted by it.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Callable, Dict, List, Optional
+import time
+from typing import Callable, Dict, List, Optional, Tuple
 
+from ..utils import get_logger
+from ..utils.backoff import capped_backoff
+from ..utils.faults import fire as _fire_fault
 from .flow_store import FlowDatabase
+
+logger = get_logger("replicated")
 
 #: result-table write/read methods the replica proxy forwards
 _TABLE_WRITES = ("insert", "insert_rows", "delete_ids",
@@ -110,13 +128,11 @@ class _ReplicatedTable:
     def __getattr__(self, name):
         if name in _TABLE_WRITES:
             def fan(*a, **kw):
-                out = 0
-                with self._db._write_lock:
-                    for r in self._db.live():
-                        out = getattr(
-                            r.result_tables[self._table_name],
-                            name)(*a, **kw)
-                return out
+                return self._db._fanout(
+                    lambda r: getattr(
+                        r.result_tables[self._table_name],
+                        name)(*a, **kw),
+                    f"{self._table_name}.{name}")
             return fan
         return getattr(self._active(), name)
 
@@ -134,6 +150,10 @@ class ReplicatedFlowDatabase:
             lambda: FlowDatabase(ttl_seconds=ttl_seconds))
         self.replicas: List = [make() for _ in range(replicas)]
         self._down: set = set()
+        #: auto-quarantined replica index → {reason, since,
+        #: failedWrites}; a subset of _down. Manual set_replica_down
+        #: marks never appear here, so the repair loop leaves them be.
+        self._quarantined: Dict[int, Dict[str, object]] = {}
         self._lock = threading.Lock()
         # Serializes fan-out writes against each other (deterministic
         # per-replica apply order) and — critically — against resync:
@@ -148,14 +168,18 @@ class ReplicatedFlowDatabase:
 
     # -- replica membership ------------------------------------------------
 
-    def live(self) -> List:
+    def _live_indexed(self) -> List[Tuple[int, object]]:
         with self._lock:
             down = set(self._down)
-        out = [r for i, r in enumerate(self.replicas) if i not in down]
+        out = [(i, r) for i, r in enumerate(self.replicas)
+               if i not in down]
         if not out:
             raise AllReplicasDownError(
                 f"all {len(self.replicas)} replicas are down")
         return out
+
+    def live(self) -> List:
+        return [r for _, r in self._live_indexed()]
 
     @property
     def active(self):
@@ -163,8 +187,14 @@ class ReplicatedFlowDatabase:
         return self.live()[0]
 
     def set_replica_down(self, index: int) -> None:
+        """Manual down-mark (operator intent): excluded from writes and
+        reads, but NOT auto-re-admitted by the repair loop — even if
+        the replica was auto-quarantined first, the manual mark
+        supersedes it (the quarantine record is dropped so repair
+        leaves the replica alone)."""
         with self._lock:
             self._down.add(index)
+            self._quarantined.pop(index, None)
 
     def set_replica_up(self, index: int, resync: bool = True) -> None:
         """Bring a replica back; by default it catches up by copying
@@ -179,6 +209,57 @@ class ReplicatedFlowDatabase:
                     self._resync(self.replicas[index], peer)
             with self._lock:
                 self._down.discard(index)
+                self._quarantined.pop(index, None)
+
+    def repair_replica(self, index: int) -> bool:
+        """The repair loop's re-admit entry: set_replica_up(resync=True)
+        gated — under the write lock — on the quarantine record still
+        existing. Returns False without touching the replica when it
+        was manually downed (or healed) after the caller sampled
+        quarantined_indices(); a bare set_replica_up here would revert
+        an operator's set_replica_down issued in that window."""
+        with self._write_lock:
+            with self._lock:
+                if index not in self._quarantined:
+                    return False
+            peer = self.active
+            if self.replicas[index] is not peer:
+                self._resync(self.replicas[index], peer)
+            with self._lock:
+                self._down.discard(index)
+                self._quarantined.pop(index, None)
+        return True
+
+    def _quarantine(self, index: int, exc: BaseException) -> None:
+        """Auto-mark a replica down after it failed a fan-out write
+        the survivors took (the divergence trigger). Caller holds
+        _write_lock; _lock nests inside it everywhere."""
+        with self._lock:
+            self._down.add(index)
+            info = self._quarantined.setdefault(
+                index, {"since": time.time(), "failedWrites": 0})
+            info["failedWrites"] = int(info["failedWrites"]) + 1
+            info["reason"] = f"{type(exc).__name__}: {exc}"
+        logger.error("replica %d quarantined after failed fan-out "
+                     "write: %s", index, exc)
+
+    def quarantined_indices(self) -> List[int]:
+        with self._lock:
+            return sorted(self._quarantined)
+
+    def membership(self) -> Dict[str, object]:
+        """Operator view of the replica set (served by /healthz)."""
+        with self._lock:
+            down = sorted(self._down)
+            quarantined = {str(i): dict(v) for i, v
+                           in sorted(self._quarantined.items())}
+        return {
+            "replicas": len(self.replicas),
+            "live": [i for i in range(len(self.replicas))
+                     if i not in down],
+            "down": down,
+            "quarantined": quarantined,
+        }
 
     @staticmethod
     def _resync(stale, peer) -> None:
@@ -196,33 +277,54 @@ class ReplicatedFlowDatabase:
 
     # -- writes (fan-out) --------------------------------------------------
 
-    def insert_flows(self, batch, now=None) -> int:
-        n = 0
+    def _fanout(self, apply: Callable, what: str):
+        """Apply one write to every live replica under the write lock.
+        A replica that raises while its peers succeed is quarantined
+        (partial failure = real divergence); the write succeeds — the
+        last successful replica's result is returned — as long as ≥1
+        replica took it. Uniform failure (every live replica raised)
+        quarantines nobody and re-raises the first error: in the
+        overwhelmingly common case (validation rejects the batch)
+        nothing was applied anywhere, and a ValueError must keep
+        reaching the client as a 400, not a replica incident. Residual
+        risk, accepted: a replica that mutates partially and THEN
+        raises, while its peers raise too, diverges without being
+        quarantined — closing that needs per-write versioning, not a
+        failure-count heuristic."""
         with self._write_lock:
-            for r in self.live():
-                n = r.insert_flows(batch, now=now)
-        return n
+            indexed = self._live_indexed()
+            out = None
+            ok = False
+            failures: List[Tuple[int, BaseException]] = []
+            for i, r in indexed:
+                try:
+                    _fire_fault("replica.write", replica=i, op=what)
+                    out = apply(r)
+                    ok = True
+                except Exception as e:
+                    failures.append((i, e))
+            if not ok:
+                raise failures[0][1]
+            for i, e in failures:
+                self._quarantine(i, e)
+            return out
+
+    def insert_flows(self, batch, now=None) -> int:
+        return self._fanout(
+            lambda r: r.insert_flows(batch, now=now), "insert_flows")
 
     def insert_flow_rows(self, rows, now=None) -> int:
-        n = 0
-        with self._write_lock:
-            for r in self.live():
-                n = r.insert_flow_rows(rows, now=now)
-        return n
+        return self._fanout(
+            lambda r: r.insert_flow_rows(rows, now=now),
+            "insert_flow_rows")
 
     def evict_ttl(self, now: int) -> int:
-        out = 0
-        with self._write_lock:
-            for r in self.live():
-                out = r.evict_ttl(now)
-        return out
+        return self._fanout(lambda r: r.evict_ttl(now), "evict_ttl")
 
     def delete_flows_older_than(self, boundary: int) -> int:
-        out = 0
-        with self._write_lock:
-            for r in self.live():
-                out = r.delete_flows_older_than(boundary)
-        return out
+        return self._fanout(
+            lambda r: r.delete_flows_older_than(boundary),
+            "delete_flows_older_than")
 
     # -- reads / passthrough ----------------------------------------------
 
@@ -259,3 +361,89 @@ class ReplicatedFlowDatabase:
         for r, ttl in zip(db.replicas, saved_ttls):
             _restore_ttl(r, ttl)
         return db
+
+
+class ReplicaRepairLoop:
+    """Background self-healing for auto-quarantined replicas: resync
+    from the active peer and re-admit via db.repair_replica (the
+    set_replica_up(resync=True) path, gated on the quarantine record
+    still existing so a concurrent manual down-mark wins) — the
+    in-memory analogue of a ClickHouse replica replaying its
+    ZooKeeper queue after an outage. Failed repair attempts back off
+    exponentially per replica (capped), so a persistently broken copy
+    is probed, not hammered. Replicas downed manually stay down (they
+    carry no quarantine record).
+
+    The clock is injectable (`time_fn`) and repair_once() is public,
+    so tests drive the schedule without sleeping."""
+
+    def __init__(self, db: ReplicatedFlowDatabase,
+                 interval: float = 2.0, base_backoff: float = 1.0,
+                 max_backoff: float = 60.0,
+                 time_fn: Callable[[], float] = time.monotonic) -> None:
+        self.db = db
+        self.interval = interval
+        self.base_backoff = base_backoff
+        self.max_backoff = max_backoff
+        self.repairs = 0
+        self.failed_attempts = 0
+        self._time = time_fn
+        self._fails: Dict[int, int] = {}
+        self._next_attempt: Dict[int, float] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="theia-replica-repair")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=15)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.repair_once()
+            except Exception as e:   # keep repairing after a bad pass
+                logger.error("replica repair pass failed: %s", e)
+
+    def repair_once(self) -> List[int]:
+        """One repair pass; returns the re-admitted replica indices."""
+        now = self._time()
+        quarantined = self.db.quarantined_indices()
+        # a replica healed elsewhere (manual set_replica_up) sheds its
+        # backoff state
+        for i in list(self._fails):
+            if i not in quarantined:
+                self._fails.pop(i, None)
+                self._next_attempt.pop(i, None)
+        healed: List[int] = []
+        for i in quarantined:
+            if self._next_attempt.get(i, 0.0) > now:
+                continue
+            try:
+                if not self.db.repair_replica(i):
+                    # manually downed (or healed elsewhere) since we
+                    # sampled the quarantine list — not ours to touch
+                    continue
+            except Exception as e:
+                self.failed_attempts += 1
+                fails = self._fails.get(i, 0) + 1
+                self._fails[i] = fails
+                delay = capped_backoff(self.base_backoff,
+                                       self.max_backoff, fails)
+                self._next_attempt[i] = now + delay
+                logger.error("replica %d repair attempt %d failed "
+                             "(%s); next attempt in %.1fs",
+                             i, fails, e, delay)
+            else:
+                self.repairs += 1
+                self._fails.pop(i, None)
+                self._next_attempt.pop(i, None)
+                healed.append(i)
+                logger.info("replica %d resynced and re-admitted "
+                            "after quarantine", i)
+        return healed

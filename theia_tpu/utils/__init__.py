@@ -4,6 +4,7 @@ Reference: pkg/util/ (utils.go, env/env.go) and klog usage throughout.
 """
 
 from .atomic import atomic_write  # noqa: F401
+from .faults import FaultError  # noqa: F401
 from .env import (  # noqa: F401
     DEFAULT_NAMESPACE,
     env_float,

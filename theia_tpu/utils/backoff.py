@@ -1,0 +1,23 @@
+"""Capped exponential backoff — the one schedule every supervisor
+shares.
+
+Reference: wait.Backoff in k8s.io/apimachinery (the Step() schedule
+the reference's controllers lean on). Four supervisors here — job
+retries, replica repair, reconciler passes, CLI polling — back off
+the same way; the arithmetic lives once so a semantics fix (jitter,
+overflow) lands everywhere.
+"""
+
+from __future__ import annotations
+
+
+def capped_backoff(base: float, cap: float, attempt: int) -> float:
+    """Delay before retry number `attempt` (1-based):
+    min(cap, base * 2**(attempt-1)). Exponent is clamped so a
+    long-failing supervisor never computes a bignum just to throw it
+    away against the cap."""
+    if attempt < 1:
+        raise ValueError(f"attempt is 1-based, got {attempt}")
+    if attempt > 64:
+        return cap
+    return min(cap, base * (2 ** (attempt - 1)))
