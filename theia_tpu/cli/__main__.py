@@ -82,17 +82,14 @@ def _auth_headers() -> Dict[str, str]:
     return {"Authorization": f"Bearer {_TOKEN}"} if _TOKEN else {}
 
 
-def _request(addr: str, method: str, path: str,
-             body: Optional[Dict] = None) -> Dict:
-    req = urllib.request.Request(
-        addr + path, method=method,
-        data=json.dumps(body).encode() if body is not None else None,
-        headers={"Content-Type": "application/json",
-                 **_auth_headers()})
+def _urlopen(addr: str, req: urllib.request.Request,
+             timeout: float = 30) -> bytes:
+    """Open a manager request, classifying failures into
+    APIError/APIConnectionError (the one place the taxonomy lives)."""
     try:
-        with urllib.request.urlopen(req, timeout=30,
+        with urllib.request.urlopen(req, timeout=timeout,
                                     context=_url_context()) as resp:
-            raw = resp.read()
+            return resp.read()
     except urllib.error.HTTPError as e:
         detail = e.read().decode(errors="replace")
         try:
@@ -109,6 +106,16 @@ def _request(addr: str, method: str, path: str,
                else APIConnectionError)
         raise cls(
             f"error: cannot reach theia-manager at {addr}: {e.reason}")
+
+
+def _request(addr: str, method: str, path: str,
+             body: Optional[Dict] = None) -> Dict:
+    req = urllib.request.Request(
+        addr + path, method=method,
+        data=json.dumps(body).encode() if body is not None else None,
+        headers={"Content-Type": "application/json",
+                 **_auth_headers()})
+    raw = _urlopen(addr, req)
     return json.loads(raw) if raw else {}
 
 
@@ -594,6 +601,84 @@ def profile(args) -> None:
           f"view with TensorBoard/xprof")
 
 
+# -- top (live rates from GET /metrics; no reference equivalent — the
+# -- closest is watching the provisioned Grafana dashboards) ------------
+
+def _request_text(addr: str, path: str) -> str:
+    """GET returning raw text (the Prometheus exposition body)."""
+    req = urllib.request.Request(addr + path, headers=_auth_headers())
+    return _urlopen(addr, req).decode()
+
+
+def _top_rows(sample, prev, dt):
+    """One render pass: (metric, labels, rate string, value string)
+    rows — counters (`*_total`) and histogram `*_count` series get a
+    per-second rate against the previous sample; gauges print their
+    value; `*_bucket` / `*_sum` series are elided (bucket grids don't
+    read as a table)."""
+    rows = []
+    for (name, labels), value in sorted(sample.items()):
+        if name.endswith(("_bucket", "_sum")):
+            continue
+        is_rate = name.endswith(("_total", "_count"))
+        rate = ""
+        if is_rate and prev is not None and dt > 0:
+            delta = value - prev.get((name, labels), 0.0)
+            rate = f"{max(delta, 0.0) / dt:,.1f}"
+        label_s = ",".join(f"{k}={v}" for k, v in labels)
+        value_s = (f"{value:,.0f}" if float(value).is_integer()
+                   else f"{value:,.2f}")
+        rows.append({"METRIC": name, "LABELS": label_s,
+                     "RATE/s": rate, "VALUE": value_s})
+    return rows
+
+
+def top(args) -> None:
+    """Poll GET /metrics and render a live rates table (rates are
+    deltas between successive scrapes)."""
+    from ..obs import prom as _prom
+    prev = None
+    prev_t = 0.0
+    i = 0
+    failures = 0
+    try:
+        while True:
+            try:
+                text = _request_text(args.manager_addr, "/metrics")
+            except APIConnectionError as e:
+                # a monitoring loop must outlive the blip it exists to
+                # observe (manager restarting, replicas resyncing) —
+                # same discipline as the job-poll retry
+                failures += 1
+                backoff = capped_backoff(
+                    max(args.interval, 0.1), 30.0, failures)
+                print(f"warning: {e}; retrying in {backoff:.0f}s",
+                      file=sys.stderr)
+                time.sleep(backoff)
+                continue
+            failures = 0
+            now = time.time()
+            sample = _prom.parse(text)
+            rows = _top_rows(sample, prev,
+                             now - prev_t if prev is not None else 0.0)
+            if not args.no_clear and sys.stdout.isatty():
+                print("\x1b[2J\x1b[H", end="")
+            stamp = datetime.datetime.fromtimestamp(now).strftime(
+                TIME_FORMAT)
+            print(f"theia top — {args.manager_addr}  {stamp}  "
+                  f"({len(rows)} series)")
+            if rows:
+                _print_table(rows, ["METRIC", "LABELS", "RATE/s",
+                                    "VALUE"])
+            prev, prev_t = sample, now
+            i += 1
+            if args.iterations and i >= args.iterations:
+                return
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+
+
 def version(args) -> None:
     from .. import __version__
     print(f"theia version: {__version__}")
@@ -808,6 +893,17 @@ def build_parser() -> argparse.ArgumentParser:
     prof.add_argument("-d", "--duration", type=float, default=3.0)
     prof.add_argument("-f", "--file", default="")
     prof.set_defaults(fn=profile)
+
+    tp = sub.add_parser("top",
+                        help="live metric rates from the manager's "
+                             "GET /metrics (Prometheus exposition)")
+    tp.add_argument("-i", "--interval", type=float, default=2.0,
+                    help="seconds between scrapes")
+    tp.add_argument("-n", "--iterations", type=int, default=0,
+                    help="render N tables then exit (0 = forever)")
+    tp.add_argument("--no-clear", dest="no_clear", action="store_true",
+                    help="append tables instead of clearing the screen")
+    tp.set_defaults(fn=top)
 
     ver = sub.add_parser("version")
     ver.set_defaults(fn=version)

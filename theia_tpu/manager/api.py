@@ -48,6 +48,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 
 from .collect import AsyncCollector
+from ..obs import metrics as _obs_metrics
+from ..obs import prom as _obs_prom
 from .jobs import (
     KIND_DD,
     KIND_FPM,
@@ -207,6 +209,7 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
     bundles: SupportBundleManager
     profiles = None   # ProfileManager
     ingest = None     # IngestManager
+    retention = None  # RetentionLoop
     auth_token: Optional[str] = None
     quiet = True
     # Socket timeout (StreamRequestHandler honors it): a client that
@@ -358,6 +361,20 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
                  "rowsIngested": self.ingest.rows_ingested,
                  "detectorShards": self.ingest.n_shards})
             return
+        if parts == ("metrics",):
+            # Prometheus exposition. Latency histograms and trace
+            # exemplars narrate traffic shape (and alert kinds carry
+            # detector output), so the surface is token-gated when
+            # auth is configured — the /alerts precedent.
+            self._require_auth()
+            self._send_metrics()
+            return
+        if parts == ("debug", "traces"):
+            # Recent + slowest spans; same sensitivity class.
+            self._require_auth()
+            limit = int(self._query().get("limit", "100"))
+            self._send_json(_obs_prom.traces_doc(limit))
+            return
         if parts == ("healthz",):
             self._send_json(self._health_doc())
             return
@@ -382,6 +399,57 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
             return
         raise KeyError(self.path)
 
+    def _send_metrics(self) -> None:
+        """Render the process registry, refreshing the scrape-time
+        gauges first (state that is cheaper to read on scrape than to
+        maintain on every write)."""
+        db = self.controller.db
+        try:
+            _obs_metrics.gauge(
+                "theia_store_flow_rows",
+                "Current flow-table rows").set(len(db.flows))
+            _obs_metrics.gauge(
+                "theia_store_flow_bytes",
+                "Current flow-table column bytes").set(db.flows.nbytes)
+        except Exception:
+            # e.g. every replica down: the store gauges go stale but
+            # the rest of the registry must stay scrapeable — an
+            # outage is exactly when the jobs/replica/fault series
+            # matter most.
+            pass
+        health = self.controller.health()
+        _obs_metrics.gauge(
+            "theia_job_queue_depth",
+            "Jobs waiting for a worker").set(health["queueDepth"])
+        _obs_metrics.gauge(
+            "theia_jobs_running",
+            "Jobs currently executing").set(health["running"])
+        if self.ingest is not None:
+            live = self.ingest.shard_liveness()
+            _obs_metrics.gauge(
+                "theia_ingest_streams",
+                "Active ingest streams").set(live["streams"])
+            _obs_metrics.gauge(
+                "theia_detector_series",
+                "Tracked connection series across detector shards"
+            ).set(sum(s["series"] for s in live["perShard"]))
+        if isinstance(db, ReplicatedFlowDatabase):
+            m = db.membership()
+            _obs_metrics.gauge(
+                "theia_replicas_live",
+                "Replicas currently serving").set(len(m["live"]))
+        if self.retention is not None:
+            _obs_metrics.gauge(
+                "theia_retention_usage_percent",
+                "Store bytes vs retention capacity").set(
+                    self.retention.stats()["usagePercent"])
+        raw = _obs_prom.render().encode()
+        self.send_response(200)
+        self.send_header("Content-Type", _obs_prom.CONTENT_TYPE)
+        self.send_header("Content-Length", str(len(raw)))
+        self.end_headers()
+        self.wfile.write(raw)
+
     def _health_doc(self) -> Dict[str, object]:
         """Liveness + degradation surface (no decoded identities, so it
         stays on the open read path): `status` is "ok" while every
@@ -402,6 +470,8 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
             doc["replicas"] = m
             if m["down"] or m["quarantined"]:
                 doc["status"] = "degraded"
+        if self.retention is not None:
+            doc["retention"] = self.retention.stats()
         armed = _faults.armed_sites()
         if armed:
             doc["faults"] = {"armed": armed}
@@ -657,6 +727,23 @@ class TheiaManagerServer:
         self.auth_token = resolve_auth_token(auth_token,
                                              auth_token_file)
         self.repairer = None
+        # Capacity-based retention, supervised (the reference runs the
+        # clickhouse-monitor sidecar unconditionally; here the loop is
+        # on unless THEIA_RETENTION_INTERVAL <= 0 disables it).
+        # THEIA_STORE_CAPACITY_BYTES overrides the API capacity arg as
+        # the trim threshold's denominator. Constructed here (cannot
+        # fail meaningfully), STARTED after the socket bind below.
+        from ..utils.env import env_float, env_int
+        self.retention = None
+        retention_interval = env_float("THEIA_RETENTION_INTERVAL",
+                                       60.0)
+        if retention_interval > 0:
+            from ..store import RetentionLoop
+            monitor = db.monitor(
+                env_int("THEIA_STORE_CAPACITY_BYTES",
+                        capacity_bytes))
+            self.retention = RetentionLoop(monitor,
+                                           interval=retention_interval)
 
         handler = type("BoundHandler", (ManagerAPIHandler,), {
             "controller": self.controller,
@@ -664,6 +751,7 @@ class TheiaManagerServer:
             "bundles": self.bundles,
             "profiles": self.profiles,
             "ingest": self.ingest,
+            "retention": self.retention,
             "auth_token": self.auth_token,
         })
         self.httpd = _TLSCapableServer((address, port), handler)
@@ -692,6 +780,8 @@ class TheiaManagerServer:
             from ..store import ReplicaRepairLoop
             self.repairer = ReplicaRepairLoop(db)
             self.repairer.start()
+        if self.retention is not None:
+            self.retention.start()
         self._thread: Optional[threading.Thread] = None
         self._serving = False
 
@@ -714,6 +804,8 @@ class TheiaManagerServer:
         self.httpd.server_close()
         if self.repairer is not None:
             self.repairer.stop()
+        if self.retention is not None:
+            self.retention.stop()
         self.ingest.close()
         self.controller.shutdown()
         if self._thread:

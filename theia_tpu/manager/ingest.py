@@ -67,11 +67,52 @@ import numpy as np
 from ..analytics.heavy_hitters import HeavyHitterDetector
 from ..analytics.streaming import StreamingDetector
 from ..ingest.native import BLOCK_MAGIC, BLOCK_MAGIC_V1, TsvDecoder
+from ..obs import metrics as _metrics
+from ..obs import trace as _trace
 from ..schema import ColumnarBatch, DictionaryMapper, StringDictionary
 from ..utils import get_logger
 from ..utils.env import env_int
 
 logger = get_logger("ingest")
+
+# Per-stage latency of the pipelined ingest path. The three stages of
+# one request overlap (store-insert ∥ detector), so their histograms
+# are independent distributions, not a partition of request time.
+_M_STAGE = _metrics.histogram(
+    "theia_ingest_stage_seconds",
+    "Per-stage ingest latency (decode under the stream lock; "
+    "store_insert and detector run overlapped)",
+    labelnames=("stage",))
+_M_STAGE_DECODE = _M_STAGE.labels(stage="decode")
+_M_STAGE_STORE = _M_STAGE.labels(stage="store_insert")
+_M_STAGE_DET = _M_STAGE.labels(stage="detector")
+_M_REQUEST = _metrics.histogram(
+    "theia_ingest_request_seconds",
+    "Whole POST /ingest request latency (decode + max(legs))")
+_M_ROWS = _metrics.counter(
+    "theia_ingest_rows_total", "Rows acked on the ingest path")
+_M_BATCHES = _metrics.counter(
+    "theia_ingest_batches_total", "Ingest payloads decoded and acked")
+_M_ERRORS = _metrics.counter(
+    "theia_ingest_errors_total",
+    "Failed ingest requests (decode errors reset the stream; insert "
+    "errors keep detector state advanced)", labelnames=("stage",))
+_M_ALERTS = _metrics.counter(
+    "theia_ingest_alerts_total", "Alerts published to the ring",
+    labelnames=("kind",))
+# Shard-scored rows use the striped increment path: the caller holds
+# the shard lock, so stripe=shard.index has exactly one writer.
+_M_SCORED = _metrics.counter(
+    "theia_ingest_scored_rows_total",
+    "Rows scored by the detector shards (striped per shard)")
+_M_LOCK_MISS = _metrics.counter(
+    "theia_ingest_shard_lock_misses_total",
+    "Opportunistic shard-lock acquisitions that found the shard busy "
+    "(the request moved on to a free shard)")
+_M_LOCK_WAIT = _metrics.counter(
+    "theia_ingest_shard_lock_waits_total",
+    "Forced blocking shard-lock acquisitions (every remaining shard "
+    "was busy — the convoy case)")
 
 MAX_ALERTS = 1000
 
@@ -249,6 +290,7 @@ class IngestManager:
         """Decode one wire payload, insert ∥ score. Raises ValueError on
         malformed payloads (mapped to HTTP 400 by the API layer); the
         failing stream is reset and must restart its encoder."""
+        t_req = time.perf_counter()
         st = self._stream(stream)
         # The stream lock guards only the DECODE (the dictionary-delta
         # chain is per-stream state); the store insert runs outside it,
@@ -265,6 +307,7 @@ class IngestManager:
         # producer that needs reproducible alerting must await each
         # response before sending the next block.
         with st.lock:
+            t_dec = time.perf_counter()
             try:
                 if payload[:4] in (BLOCK_MAGIC, BLOCK_MAGIC_V1):
                     batch = st.decoder.decode_block(payload)
@@ -275,7 +318,9 @@ class IngestManager:
                 # dictionaries (TSV minting is not transactional) —
                 # discard the stream rather than serve a desynced one.
                 self._drop_stream(stream, st)
+                _M_ERRORS.labels(stage="decode").inc()
                 raise
+            _M_STAGE_DECODE.observe(time.perf_counter() - t_dec)
         # Pipelined legs: the store insert (MV fan-out, TTL) and the
         # detector scoring are independent consumers of the decoded
         # batch (both read-only), so they run overlapped and the
@@ -288,15 +333,21 @@ class IngestManager:
         # batch's alerts are still withheld (published only after the
         # insert leg succeeds, below), and the store itself stays
         # exactly-once.
-        fut = self._insert_pool.submit(self.db.insert_flows, batch)
+        fut = self._insert_pool.submit(self._timed_insert, batch)
         try:
+            t_det = time.perf_counter()
             alerts, conn_alerts, n_conn = self.score_batch(batch)
+            _M_STAGE_DET.observe(time.perf_counter() - t_det)
+        except Exception:
+            _M_ERRORS.labels(stage="detector").inc()
+            raise
         finally:
             # Always await the insert leg, even when scoring raised:
             # an unawaited future would hide the store's exception and
             # break the acked-rows conservation contract.
             insert_exc = fut.exception()
         if insert_exc is not None:
+            _M_ERRORS.labels(stage="store_insert").inc()
             raise insert_exc
         n = fut.result()
         now = time.time()
@@ -308,9 +359,33 @@ class IngestManager:
             for d in conn_alerts:
                 self._alerts.appendleft({**d, "time": now})
             self.rows_ingested += n
+        _M_BATCHES.inc()
+        _M_ROWS.inc(n)
+        if alerts:
+            _M_ALERTS.labels(kind="heavy_hitter").inc(len(alerts))
+        if n_conn:
+            _M_ALERTS.labels(kind="connection_anomaly").inc(n_conn)
+        dt_req = time.perf_counter() - t_req
+        _M_REQUEST.observe(dt_req)
+        # Flight-record slow requests only: publishing every batch
+        # would wash real incidents out of the bounded span ring.
+        if dt_req >= self.TRACE_SLOW_SECONDS:
+            _trace.record("ingest.request", now - dt_req, dt_req,
+                          stream=stream, rows=n, alerts=n_alerts)
         if n_alerts:
             logger.v(1).info("ingested %d rows, %d alerts", n, n_alerts)
         return {"rows": n, "alerts": n_alerts}
+
+    #: requests at least this slow land in the trace ring as
+    #: "ingest.request" spans (fast ones only move the histograms)
+    TRACE_SLOW_SECONDS = 0.1
+
+    def _timed_insert(self, batch: ColumnarBatch) -> int:
+        t0 = time.perf_counter()
+        try:
+            return self.db.insert_flows(batch)
+        finally:
+            _M_STAGE_STORE.observe(time.perf_counter() - t0)
 
     # -- detector leg ----------------------------------------------------
 
@@ -349,8 +424,12 @@ class IngestManager:
                         shard.lock.release()
                     progressed = True
                 else:
+                    _M_LOCK_MISS.inc()
                     pending.append((shard, part))
             if not progressed and pending:
+                # every remaining shard is busy — the convoy case the
+                # opportunistic pass exists to avoid
+                _M_LOCK_WAIT.inc()
                 shard, part = pending.popleft()
                 with shard.lock:
                     n_conn += self._score_shard(
@@ -380,6 +459,9 @@ class IngestManager:
         per-connection slots) persists across batches, so keys must
         mean the same endpoint whichever stream (or stream generation)
         produced the batch."""
+        # Striped, lock-free increment: this thread holds shard.lock,
+        # so it is the only writer of the shard's counter stripe.
+        _M_SCORED.inc(len(part), stripe=shard.index)
         extra = float(self._shard_totals.sum()
                       - self._shard_totals[shard.index])
         hh_alerts.extend(shard.heavy.update(part, extra_total=extra))

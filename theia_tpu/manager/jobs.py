@@ -50,6 +50,8 @@ import numpy as np
 
 from ..analytics import (TadQuerySpec, run_drop_detection, run_npr,
                          run_pattern_mining, run_spatial, run_tad)
+from ..obs import metrics as _obs_metrics
+from ..obs import trace as _obs_trace
 from ..runner.__main__ import TIME_FORMAT as RUNNER_TIME_FORMAT
 from ..runner.__main__ import TRANSIENT_EXIT_CODE
 from ..runner.progress import (DD_STAGES, FPM_STAGES, NPR_STAGES,
@@ -63,6 +65,22 @@ from ..utils.faults import FaultError
 from ..utils.faults import fire as _fire_fault
 
 logger = get_logger("jobs")
+
+_M_QUEUE_WAIT = _obs_metrics.histogram(
+    "theia_job_queue_wait_seconds",
+    "Time from job creation to its first execution attempt")
+_M_RUN = _obs_metrics.histogram(
+    "theia_job_run_seconds",
+    "Wall time of one job execution attempt", labelnames=("kind",))
+_M_JOBS = _obs_metrics.counter(
+    "theia_jobs_total", "Jobs reaching a terminal state",
+    labelnames=("kind", "state"))
+_M_RETRIES = _obs_metrics.counter(
+    "theia_job_retries_total",
+    "Transient job failures re-queued with backoff")
+_M_DEADLINE_KILLS = _obs_metrics.counter(
+    "theia_job_deadline_kills_total",
+    "Runner children killed at deadlineSeconds")
 
 STATE_NEW = "NEW"
 STATE_SCHEDULED = "SCHEDULED"
@@ -145,6 +163,7 @@ class JobRecord:
     deadline_seconds: float = 0.0       # spec `deadlineSeconds`; 0 = off
     attempts: int = 0                   # completed execution attempts
     last_failure: str = ""              # most recent attempt's failure
+    created_time: float = 0.0           # queue-wait measurement anchor
 
     @property
     def job_id(self) -> str:
@@ -239,7 +258,8 @@ class JobController:
         record = JobRecord(name=name, kind=kind, spec=dict(spec),
                            state=STATE_SCHEDULED,
                            max_retries=self._spec_retries(spec),
-                           deadline_seconds=self._spec_deadline(spec))
+                           deadline_seconds=self._spec_deadline(spec),
+                           created_time=time.time())
         if record.deadline_seconds and self.dispatch == "thread":
             # an in-process job shares our interpreter; Python offers
             # no safe thread kill, so only subprocess dispatch can
@@ -379,6 +399,7 @@ class JobController:
                      and not self._deleted(record)
                      and not self._stop.is_set())
         if retryable:
+            _M_RETRIES.inc()
             delay = self._retry_delay(record)
             record.state = STATE_SCHEDULED
             logger.error("job %s attempt %d/%d failed (%s); retrying "
@@ -402,6 +423,7 @@ class JobController:
             return
         record.state = STATE_FAILED
         record.error_msg = msg
+        _M_JOBS.labels(kind=record.kind, state="failed").inc()
         if record.progress:
             record.progress.fail(msg)
         logger.error("job %s failed: %s\n%s", record.name, msg,
@@ -411,14 +433,21 @@ class JobController:
         record.state = STATE_RUNNING
         record.attempts += 1
         record.start_time = time.time()
+        if record.attempts == 1 and record.created_time:
+            _M_QUEUE_WAIT.observe(
+                max(0.0, record.start_time - record.created_time))
         logger.v(1).info("job %s started (%s, attempt %d)", record.name,
                          self.dispatch, record.attempts)
         try:
-            if self.dispatch == "subprocess":
-                self._run_subprocess(record)
-            else:
-                self._run_inprocess(record)
+            with _obs_trace.span("job.run", job=record.name,
+                                 kind=record.kind,
+                                 attempt=record.attempts):
+                if self.dispatch == "subprocess":
+                    self._run_subprocess(record)
+                else:
+                    self._run_inprocess(record)
             record.state = STATE_COMPLETED
+            _M_JOBS.labels(kind=record.kind, state="completed").inc()
             logger.v(1).info("job %s completed in %.2fs", record.name,
                              time.time() - record.start_time)
             if record.kind == KIND_SPATIAL and self.alert_sink:
@@ -433,6 +462,8 @@ class JobController:
             self._on_failure(record, e)
         finally:
             record.end_time = time.time()
+            _M_RUN.labels(kind=record.kind).observe(
+                max(0.0, record.end_time - record.start_time))
             # If the CR was deleted while the job ran, its result rows
             # were written after delete()'s GC — clean them up now so
             # in-flight deletes keep the reference's cleanup semantics.
@@ -732,6 +763,7 @@ class JobController:
             except OSError:
                 pass
             if deadline_hit:
+                _M_DEADLINE_KILLS.inc()
                 raise DeadlineExceeded(
                     f"runner exceeded deadlineSeconds={deadline_s:g} "
                     f"and was killed")

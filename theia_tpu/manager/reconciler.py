@@ -33,6 +33,7 @@ import os
 import threading
 from typing import Dict, Optional, Tuple
 
+from ..obs import metrics as _metrics
 from ..utils import get_logger
 from ..utils.backoff import capped_backoff
 from ..utils.faults import fire as _fire_fault
@@ -41,6 +42,15 @@ from .jobs import (KIND_DD, KIND_FPM, KIND_NPR, KIND_SPATIAL,
                    DuplicateJobError)
 
 logger = get_logger("reconciler")
+
+_M_PASSES = _metrics.counter(
+    "theia_reconciler_passes_total",
+    "Reconcile passes over the CR directory, by outcome",
+    labelnames=("result",))
+_M_OBJECTS = _metrics.counter(
+    "theia_reconciler_objects_total",
+    "CRs admitted into / deleted from the controller by the "
+    "reconciler", labelnames=("action",))
 
 CRD_GROUP = "crd.theia.antrea.io"
 API_VERSION = f"{CRD_GROUP}/v1alpha1"
@@ -106,6 +116,7 @@ class DeclarativeReconciler:
             try:
                 self.reconcile_once()
             except Exception as e:   # keep reconciling after bad input
+                _M_PASSES.labels(result="error").inc()
                 self.consecutive_failures += 1
                 self.current_delay = capped_backoff(
                     self.interval * 2, self.backoff_cap,
@@ -230,6 +241,11 @@ class DeclarativeReconciler:
             self._terminal.pop(name, None)
 
         self._write_statuses(desired)
+        _M_PASSES.labels(result="ok").inc()
+        if created:
+            _M_OBJECTS.labels(action="created").inc(created)
+        if deleted:
+            _M_OBJECTS.labels(action="deleted").inc(deleted)
         return {"desired": len(desired), "created": created,
                 "deleted": deleted}
 

@@ -35,9 +35,18 @@ class StatsProvider:
         self._last_sample = (time.time(), self._row_byte_totals())
 
     def _row_byte_totals(self):
-        rows = len(self.db.flows)
-        nbytes = self.db.flows.nbytes
-        return rows, nbytes
+        """CUMULATIVE inserted rows/bytes, not net table size: net size
+        made insert_rates under-report after any delete (a retention
+        trim of N rows masked the next N inserted rows — the rate
+        read 0 while ingest ran hot). The cumulative counters only
+        grow, so the delta between samples is exactly what arrived.
+        Falls back to net size for stores that predate the counters
+        (e.g. a bare Table stub in tests)."""
+        db = self.db
+        rows = getattr(db, "rows_inserted_total", None)
+        if rows is not None:
+            return int(rows), int(db.bytes_inserted_total)
+        return len(db.flows), db.flows.nbytes
 
     def disk_infos(self) -> List[Dict[str, str]]:
         used = self.db.flows.nbytes + sum(
@@ -83,6 +92,8 @@ class StatsProvider:
             then, (prev_rows, prev_bytes) = self._last_sample
             self._last_sample = (now, (rows, nbytes))
         dt = max(now - then, 1e-9)
+        # Cumulative totals are monotone, so the max() guard only
+        # protects against a swapped-out db object, not deletes.
         return [{
             "shard": self.shard,
             "rowsPerSec": str(int(max(rows - prev_rows, 0) / dt)),

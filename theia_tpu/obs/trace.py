@@ -1,0 +1,177 @@
+"""Lightweight tracing: spans with per-thread context, a bounded ring
+of recent spans, and slowest-span exemplars per operation.
+
+Not a distributed tracer — a flight recorder. Every instrumented
+operation wraps itself in `span("op")` (context manager) or `@traced`
+(decorator); finished spans land in a fixed-size ring (newest first on
+read) and the slowest span seen per operation is kept as an exemplar,
+so "why was ingest slow at 14:03" has an answer without a profiler
+attached. Per-thread context links a span to the operation that
+enclosed it (`parent`), which is how a slow store insert inside a slow
+ingest request reads as one story.
+
+Span records are plain dicts (JSON-ready for GET /debug/traces):
+
+    {"op", "startTime", "durationMs", "parent", "thread", ...attrs}
+
+Env knobs:
+
+    THEIA_TRACE_RING   ring capacity (default 256; 0 disables
+                       recording — span() still times, nothing is kept)
+
+Recording honors metrics.disable() (one kill switch for the whole obs
+plane). Mutating an attr on the yielded span inside the `with` body
+(`sp.attrs["rows"] = n`) annotates the record before it is published.
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+import threading
+import time
+from typing import Deque, Dict, List, Optional
+
+from . import metrics as _metrics
+
+
+def _ring_capacity() -> int:
+    return max(0, _metrics._env_int("THEIA_TRACE_RING", 256))
+
+
+#: distinct operations tracked for exemplars (bounds the dict; beyond
+#: this, new op names are recorded in the ring but not as exemplars)
+MAX_EXEMPLAR_OPS = 128
+
+_lock = threading.Lock()
+_ring: Deque[Dict[str, object]] = collections.deque(
+    maxlen=_ring_capacity())
+_slowest: Dict[str, Dict[str, object]] = {}
+_local = threading.local()
+
+
+class Span:
+    """One in-flight operation; finished spans publish as dicts."""
+
+    __slots__ = ("op", "attrs", "_t0", "_start", "parent")
+
+    def __init__(self, op: str, attrs: Dict[str, object]) -> None:
+        self.op = op
+        self.attrs = attrs
+        self.parent: Optional[str] = None
+        self._t0 = 0.0
+        self._start = 0.0
+
+    def __enter__(self) -> "Span":
+        stack = getattr(_local, "stack", None)
+        if stack is None:
+            stack = _local.stack = []
+        self.parent = stack[-1] if stack else None
+        stack.append(self.op)
+        self._start = time.time()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        duration = time.perf_counter() - self._t0
+        stack = getattr(_local, "stack", None)
+        if stack:
+            stack.pop()
+        if not _metrics.enabled():
+            return
+        record: Dict[str, object] = {
+            "op": self.op,
+            "startTime": self._start,
+            "durationMs": round(duration * 1e3, 4),
+            "parent": self.parent,
+            "thread": threading.current_thread().name,
+        }
+        if exc_type is not None:
+            record["error"] = exc_type.__name__
+        record.update(self.attrs)
+        _publish(record)
+
+
+def _publish(record: Dict[str, object]) -> None:
+    # THEIA_TRACE_RING=0 promises NO span retention — exemplars are
+    # retained state too (attrs carry stream ids and job names), so
+    # the knob turns them off with the ring.
+    if not _ring.maxlen:
+        return
+    op = str(record["op"])
+    with _lock:
+        _ring.append(record)
+        best = _slowest.get(op)
+        if best is None:
+            if len(_slowest) < MAX_EXEMPLAR_OPS:
+                _slowest[op] = record
+        elif record["durationMs"] > best["durationMs"]:
+            _slowest[op] = record
+
+
+def record(op: str, start_time: float, duration_s: float,
+           **attrs: object) -> None:
+    """Publish an already-timed span (hot paths that keep their own
+    stopwatches and only record the interesting tail)."""
+    if not _metrics.enabled():
+        return
+    rec: Dict[str, object] = {
+        "op": op,
+        "startTime": start_time,
+        "durationMs": round(duration_s * 1e3, 4),
+        "parent": current_op(),
+        "thread": threading.current_thread().name,
+    }
+    rec.update(attrs)
+    _publish(rec)
+
+
+def span(op: str, **attrs: object) -> Span:
+    """Context manager timing one operation:
+
+        with span("ingest.request", stream=sid) as sp:
+            ...
+            sp.attrs["rows"] = n
+    """
+    return Span(op, dict(attrs))
+
+
+def traced(op: Optional[str] = None):
+    """Decorator form of span(); the op name defaults to the function's
+    qualified name."""
+    def wrap(fn):
+        name = op or fn.__qualname__
+
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            with span(name):
+                return fn(*args, **kwargs)
+        return inner
+    return wrap
+
+
+def current_op() -> Optional[str]:
+    """The innermost span op on this thread (None outside any span)."""
+    stack = getattr(_local, "stack", None)
+    return stack[-1] if stack else None
+
+
+def recent(limit: int = 100) -> List[Dict[str, object]]:
+    """Most recent finished spans, newest first."""
+    with _lock:
+        out = list(_ring)
+    out.reverse()
+    return out[:max(0, limit)]
+
+
+def slowest() -> Dict[str, Dict[str, object]]:
+    """op → its slowest recorded span (the exemplar)."""
+    with _lock:
+        return {op: dict(rec) for op, rec in sorted(_slowest.items())}
+
+
+def reset() -> None:
+    """Drop the ring and exemplars (tests)."""
+    with _lock:
+        _ring.clear()
+        _slowest.clear()

@@ -335,7 +335,16 @@ def main(argv=None) -> None:
                "dropdetection": run_dd_job,
                "patterns": run_patterns_job,
                "spatial": run_spatial_job}
-    job_id = runners[args.job](args)
+    # Trace the whole run and ship the timing summary on stderr: this
+    # process dies with the job, so its obs state surfaces through the
+    # stderr tail the controller keeps on the record (runner_log_tail,
+    # the support bundle's runner-log source).
+    from ..obs import trace
+    with trace.span("runner.job", job=args.job, id=args.id or ""):
+        job_id = runners[args.job](args)
+    for op, rec in trace.slowest().items():
+        print(f"timing {op}: {rec['durationMs']:.1f} ms",
+              file=sys.stderr)
     print(json.dumps({"id": job_id, "state": "COMPLETED"}))
 
 

@@ -1,7 +1,8 @@
 """Columnar flow store: tables, materialized views, TTL, retention."""
 
 from .checkpoint import Checkpointer
-from .flow_store import FlowDatabase, RetentionMonitor, Table
+from .flow_store import (FlowDatabase, RetentionLoop, RetentionMonitor,
+                         Table)
 from .replicated import (AllReplicasDownError, ReplicaRepairLoop,
                          ReplicatedFlowDatabase)
 from .sharded import (DistributedTable, DistributedView,
@@ -12,7 +13,7 @@ from .views import (MATERIALIZED_VIEWS, ViewSpec, ViewTable, group_reduce,
 __all__ = [
     "AllReplicasDownError", "Checkpointer", "FlowDatabase",
     "ReplicaRepairLoop", "ReplicatedFlowDatabase",
-    "RetentionMonitor", "Table",
+    "RetentionLoop", "RetentionMonitor", "Table",
     "DistributedTable", "DistributedView", "ShardedFlowDatabase",
     "MATERIALIZED_VIEWS", "ViewSpec", "ViewTable", "group_reduce", "group_sum",
 ]

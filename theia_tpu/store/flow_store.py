@@ -22,6 +22,7 @@ from __future__ import annotations
 import concurrent.futures
 import os
 import threading
+import time
 from typing import Dict, List, Mapping, Optional, Sequence
 
 import numpy as np
@@ -47,9 +48,38 @@ RESULT_TABLE_SCHEMAS = (
     ("flowpatterns", FLOWPATTERNS_SCHEMA),
     ("spatialnoise", SPATIALNOISE_SCHEMA),
 )
+from ..obs import metrics as _metrics
+from ..utils.backoff import capped_backoff
+from ..utils.env import env_float
 from ..utils.faults import fire as _fire_fault
+from ..utils.logging import get_logger
 from ..utils.pool import get_pool
 from .views import MATERIALIZED_VIEWS, ViewTable
+
+_logger = get_logger("store")
+
+_M_INS_ROWS = _metrics.counter(
+    "theia_store_inserted_rows_total",
+    "Flow rows inserted, cumulative over every physical store in the "
+    "process (a replicated fan-out counts once per replica)")
+_M_INS_BYTES = _metrics.counter(
+    "theia_store_inserted_bytes_total",
+    "Column bytes of inserted flow rows (store-coded), cumulative per "
+    "physical store")
+_M_DEL_ROWS = _metrics.counter(
+    "theia_store_deleted_rows_total",
+    "Flow rows deleted by TTL eviction or retention trims",
+    labelnames=("reason",))
+_M_MV_FANOUT = _metrics.histogram(
+    "theia_store_mv_fanout_seconds",
+    "Materialized-view fan-out time per inserted block (all views)")
+_M_RET_ROUNDS = _metrics.counter(
+    "theia_retention_rounds_total",
+    "Retention-monitor rounds, by outcome",
+    labelnames=("result",))
+_M_RET_DELETED = _metrics.counter(
+    "theia_retention_rows_deleted_total",
+    "Flow rows trimmed by capacity-based retention rounds")
 
 
 def _view_pool() -> concurrent.futures.ThreadPoolExecutor:
@@ -77,6 +107,12 @@ class Table:
         #: checkpointer's change detector; row counts alone can't see
         #: same-size churn (TTL evicts N, ingest adds N)
         self.generation = 0
+        # Cumulative insert totals (rows / store-coded column bytes),
+        # maintained under the table lock. Unlike net table size these
+        # never decrease, so insert-rate stats based on them survive
+        # retention trims (deletes used to mask real throughput).
+        self.rows_inserted_total = 0
+        self.bytes_inserted_total = 0
         # Cached source-dict → table-dict code mappings: a producer
         # streaming blocks with its own dictionaries pays string
         # re-encode only for NEW entries, not per block (the 6.6x
@@ -120,9 +156,12 @@ class Table:
         if len(batch) == 0:
             return None
         adopted = self._adopt(batch)
+        nbytes = sum(a.nbytes for a in adopted.columns.values())
         with self._lock:
             self._batches.append(adopted)
             self.generation += 1
+            self.rows_inserted_total += len(adopted)
+            self.bytes_inserted_total += nbytes
         return adopted
 
     def insert_rows(self, rows: Sequence[Mapping[str, object]]) -> int:
@@ -292,7 +331,103 @@ class RetentionMonitor:
         deleted = self.db.delete_flows_older_than(int(boundary))
         if deleted:
             self._remaining_skip = self.skip_rounds
+            _M_RET_DELETED.inc(deleted)
+            _M_DEL_ROWS.labels(reason="retention").inc(deleted)
         return deleted
+
+
+class RetentionLoop:
+    """Supervised background driver for RetentionMonitor — the role of
+    the reference's clickhouse-monitor sidecar loop
+    (plugins/clickhouse-monitor/main.go:83-101: a ticker that runs a
+    monitor round forever). The monitor itself stays a pure
+    one-round-per-tick object; this loop owns the thread, the
+    schedule, and the failure policy:
+
+      * one `tick()` per THEIA_RETENTION_INTERVAL seconds (injectable
+        for tests via `interval`/`run_once()` — no sleeping tests);
+      * a FAILED round (e.g. every replica down mid-trim) backs off
+        with the shared `capped_backoff` schedule instead of hammering
+        a broken store every interval; the first clean round resets
+        the cadence;
+      * rounds / rows-deleted / failures are counted here (and as
+        metrics), surfaced through `stats()` on GET /healthz.
+    """
+
+    def __init__(self, monitor: RetentionMonitor,
+                 interval: Optional[float] = None,
+                 backoff_cap: float = 300.0) -> None:
+        self.monitor = monitor
+        self.interval = (env_float("THEIA_RETENTION_INTERVAL", 60.0)
+                         if interval is None else float(interval))
+        self.backoff_cap = backoff_cap
+        self.rounds = 0
+        self.rows_deleted = 0
+        self.failures = 0
+        self.consecutive_failures = 0
+        self.current_delay = self.interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="theia-retention")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=15)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.current_delay):
+            self.run_once()
+
+    def run_once(self) -> int:
+        """One supervised round; returns rows deleted (0 on a failed
+        round). Public so tests drive the schedule synchronously."""
+        try:
+            deleted = self.monitor.tick()
+        except Exception as e:   # a bad round must not kill the loop
+            self.failures += 1
+            self.consecutive_failures += 1
+            self.current_delay = capped_backoff(
+                max(self.interval, 0.001) * 2, self.backoff_cap,
+                self.consecutive_failures)
+            _M_RET_ROUNDS.labels(result="error").inc()
+            _logger.error(
+                "retention round failed (%d consecutive): %s; "
+                "backing off %.1fs", self.consecutive_failures, e,
+                self.current_delay)
+            return 0
+        if self.consecutive_failures:
+            _logger.info("retention recovered after %d failed rounds",
+                         self.consecutive_failures)
+        self.consecutive_failures = 0
+        self.current_delay = self.interval
+        self.rounds += 1
+        self.rows_deleted += deleted
+        _M_RET_ROUNDS.labels(
+            result="trimmed" if deleted else "idle").inc()
+        if deleted:
+            _logger.info("retention trimmed %d rows (usage %.1f%%)",
+                         deleted, self.monitor.usage() * 100)
+        return deleted
+
+    def stats(self) -> Dict[str, object]:
+        """Operator view (merged into GET /healthz)."""
+        try:
+            usage = self.monitor.usage()
+        except Exception:
+            usage = float("nan")
+        return {
+            "rounds": self.rounds,
+            "rowsDeleted": self.rows_deleted,
+            "failures": self.failures,
+            "intervalSeconds": self.interval,
+            "capacityBytes": self.monitor.capacity_bytes,
+            "usagePercent": round(usage * 100, 2),
+        }
 
 
 class FlowDatabase:
@@ -335,6 +470,7 @@ class FlowDatabase:
         # out in parallel for large blocks (ClickHouse runs MV pipelines
         # per insert block concurrently too).
         views = list(self.views.values())
+        t_mv = time.perf_counter()
         if (len(adopted) >= 16384 and len(views) > 1
                 and (os.cpu_count() or 1) > 2):
             # Parallel only where cores exist (TPU hosts); on small
@@ -344,6 +480,10 @@ class FlowDatabase:
         else:
             for view in views:
                 view.apply_insert_block(adopted)
+        _M_MV_FANOUT.observe(time.perf_counter() - t_mv)
+        _M_INS_ROWS.inc(len(adopted))
+        _M_INS_BYTES.inc(sum(a.nbytes
+                             for a in adopted.columns.values()))
         if self.ttl_seconds is not None:
             now = int(now if now is not None
                       else np.max(adopted["timeInserted"]))
@@ -354,6 +494,16 @@ class FlowDatabase:
         return self.insert_flows(
             ColumnarBatch.from_rows(rows, FLOW_SCHEMA, self.flows.dicts),
             now=now)
+
+    @property
+    def rows_inserted_total(self) -> int:
+        """Cumulative flow rows ever inserted (monotone — deletes do
+        not decrease it); the insert-rate substrate."""
+        return self.flows.rows_inserted_total
+
+    @property
+    def bytes_inserted_total(self) -> int:
+        return self.flows.bytes_inserted_total
 
     # -- retention ---------------------------------------------------------
 
@@ -366,7 +516,10 @@ class FlowDatabase:
         oldest = self.flows.min_value("timeInserted")
         if oldest is None or oldest >= boundary:
             return 0
-        return self.delete_flows_older_than(boundary)
+        deleted = self.delete_flows_older_than(boundary)
+        if deleted:
+            _M_DEL_ROWS.labels(reason="ttl").inc(deleted)
+        return deleted
 
     def delete_flows_older_than(self, boundary: int) -> int:
         """timeInserted < boundary, applied to flows and every view

@@ -40,12 +40,27 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+import numpy as np
+
+from ..obs import metrics as _metrics
 from ..utils import get_logger
 from ..utils.backoff import capped_backoff
 from ..utils.faults import fire as _fire_fault
 from .flow_store import FlowDatabase
 
 logger = get_logger("replicated")
+
+_M_REPL_WRITE = _metrics.histogram(
+    "theia_replica_write_seconds",
+    "Per-replica fan-out write latency", labelnames=("replica",))
+_M_REPL_QUAR = _metrics.counter(
+    "theia_replica_quarantines_total",
+    "Replicas auto-quarantined after a failed fan-out write the "
+    "survivors took")
+_M_REPL_REPAIR = _metrics.counter(
+    "theia_replica_repairs_total",
+    "Repair-loop resync attempts on quarantined replicas, by outcome",
+    labelnames=("result",))
 
 #: result-table write/read methods the replica proxy forwards
 _TABLE_WRITES = ("insert", "insert_rows", "delete_ids",
@@ -165,6 +180,13 @@ class ReplicatedFlowDatabase:
             for name in self.replicas[0].result_tables}
         for name, proxy in self.result_tables.items():
             setattr(self, name, proxy)
+        # LOGICAL cumulative insert totals, counted once per fan-out
+        # write (not per replica). The per-replica Table counters are
+        # physical and jump on resync (truncate + full re-insert), so
+        # proxying them through `active` would spike the insert-rate
+        # stats on every failover; these stay monotone instead.
+        self._rows_inserted_total = 0
+        self._bytes_inserted_total = 0
 
     # -- replica membership ------------------------------------------------
 
@@ -240,6 +262,7 @@ class ReplicatedFlowDatabase:
                 index, {"since": time.time(), "failedWrites": 0})
             info["failedWrites"] = int(info["failedWrites"]) + 1
             info["reason"] = f"{type(exc).__name__}: {exc}"
+        _M_REPL_QUAR.inc()
         logger.error("replica %d quarantined after failed fan-out "
                      "write: %s", index, exc)
 
@@ -297,12 +320,16 @@ class ReplicatedFlowDatabase:
             ok = False
             failures: List[Tuple[int, BaseException]] = []
             for i, r in indexed:
+                t0 = time.perf_counter()
                 try:
                     _fire_fault("replica.write", replica=i, op=what)
                     out = apply(r)
                     ok = True
                 except Exception as e:
                     failures.append((i, e))
+                finally:
+                    _M_REPL_WRITE.labels(replica=str(i)).observe(
+                        time.perf_counter() - t0)
             if not ok:
                 raise failures[0][1]
             for i, e in failures:
@@ -310,13 +337,37 @@ class ReplicatedFlowDatabase:
             return out
 
     def insert_flows(self, batch, now=None) -> int:
-        return self._fanout(
+        n = self._fanout(
             lambda r: r.insert_flows(batch, now=now), "insert_flows")
+        nbytes = sum(np.asarray(a).nbytes
+                     for a in batch.columns.values())
+        with self._lock:
+            self._rows_inserted_total += n
+            self._bytes_inserted_total += nbytes
+        return n
 
     def insert_flow_rows(self, rows, now=None) -> int:
-        return self._fanout(
+        n = self._fanout(
             lambda r: r.insert_flow_rows(rows, now=now),
             "insert_flow_rows")
+        with self._lock:
+            # row-shaped inserts carry no columnar byte size here; the
+            # rows counter still moves (bytes stay a lower bound)
+            self._rows_inserted_total += n
+        return n
+
+    @property
+    def rows_inserted_total(self) -> int:
+        """Cumulative LOGICAL flow rows written through the fan-out
+        (monotone across failover and resync, unlike the per-replica
+        physical counters)."""
+        with self._lock:
+            return self._rows_inserted_total
+
+    @property
+    def bytes_inserted_total(self) -> int:
+        with self._lock:
+            return self._bytes_inserted_total
 
     def evict_ttl(self, now: int) -> int:
         return self._fanout(lambda r: r.evict_ttl(now), "evict_ttl")
@@ -431,6 +482,7 @@ class ReplicaRepairLoop:
                     continue
             except Exception as e:
                 self.failed_attempts += 1
+                _M_REPL_REPAIR.labels(result="failed").inc()
                 fails = self._fails.get(i, 0) + 1
                 self._fails[i] = fails
                 delay = capped_backoff(self.base_backoff,
@@ -441,6 +493,7 @@ class ReplicaRepairLoop:
                              i, fails, e, delay)
             else:
                 self.repairs += 1
+                _M_REPL_REPAIR.labels(result="repaired").inc()
                 self._fails.pop(i, None)
                 self._next_attempt.pop(i, None)
                 healed.append(i)

@@ -70,6 +70,14 @@ class DistributedTable:
         only grow)."""
         return sum(t.generation for t in self.tables)
 
+    @property
+    def rows_inserted_total(self) -> int:
+        return sum(t.rows_inserted_total for t in self.tables)
+
+    @property
+    def bytes_inserted_total(self) -> int:
+        return sum(t.bytes_inserted_total for t in self.tables)
+
     def _assign(self, n: int) -> np.ndarray:
         with self._lock:   # rand() routing; rng isn't thread-safe
             return self._rng.integers(0, len(self.tables), size=n)
@@ -221,6 +229,16 @@ class ShardedFlowDatabase:
     @property
     def n_shards(self) -> int:
         return len(self.shards)
+
+    @property
+    def rows_inserted_total(self) -> int:
+        """Cumulative flow rows inserted across every shard (monotone;
+        the cluster-wide insert-rate substrate)."""
+        return self.flows.rows_inserted_total
+
+    @property
+    def bytes_inserted_total(self) -> int:
+        return self.flows.bytes_inserted_total
 
     # -- ingest ----------------------------------------------------------
 

@@ -55,7 +55,14 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from ..obs import metrics as _metrics
+
 MODES = ("error", "hang")
+
+_M_FIRINGS = _metrics.counter(
+    "theia_fault_firings_total",
+    "Armed fault points that actually injected (raised or hung)",
+    labelnames=("site", "mode"))
 
 
 class FaultError(Exception):
@@ -165,6 +172,7 @@ class FaultInjector:
             elif rule.probability < 1.0 and \
                     self._rng.random() >= rule.probability:
                 return
+        _M_FIRINGS.labels(site=site, mode=rule.mode).inc()
         if rule.mode == "hang":
             self._hang()
             return
