@@ -596,6 +596,111 @@ def run_benchmarks() -> dict:
     except Exception as e:
         print(f"metrics-overhead bench skipped: {e}", file=sys.stderr)
 
+    # WAL durability tax: e2e ingest throughput (the acceptance
+    # surface — decode ∥ store+WAL ∥ detector, where spare cores can
+    # absorb the journaling) per sync policy vs the WAL-off baseline,
+    # plus bare store-insert rates (the worst case: nothing overlaps)
+    # and replay throughput (how fast a crash recovers). Interleaved
+    # best-of-3 per mode, same rationale as the metrics A/B:
+    # consecutive same-mode passes fold host drift into the
+    # difference.
+    wal_rates = {}
+    wal_store_rates = {}
+    wal_recovery = 0.0
+    try:
+        import shutil
+        import tempfile
+
+        from theia_tpu.ingest import BlockEncoder as _WalEnc
+        from theia_tpu.manager.ingest import IngestManager as _WalIm
+        from theia_tpu.store import FlowDatabase as _WalDb
+
+        bigw = generate_flows(SynthConfig(n_series=2000,
+                                          points_per_series=30))
+
+        def wal_store_pass(sync):
+            tmpd = tempfile.mkdtemp(prefix="theia-wal-bench-")
+            try:
+                dbw = _WalDb(ttl_seconds=12 * 3600)
+                if sync is not None:
+                    dbw.attach_wal(os.path.join(tmpd, "wal"),
+                                   sync=sync)
+                dbw.insert_flows(bigw)   # warm adopt caches + jit
+                tw = time.perf_counter()
+                n = sum(dbw.insert_flows(bigw) for _ in range(8))
+                dtw = time.perf_counter() - tw
+                if sync is not None:
+                    dbw.close_wal()
+                return n / dtw
+            finally:
+                shutil.rmtree(tmpd, ignore_errors=True)
+
+        def wal_e2e_pass(sync):
+            tmpd = tempfile.mkdtemp(prefix="theia-wal-bench-")
+            try:
+                dbw = _WalDb(ttl_seconds=12 * 3600)
+                if sync is not None:
+                    dbw.attach_wal(os.path.join(tmpd, "wal"),
+                                   sync=sync)
+                imw = _WalIm(dbw)
+                encw = _WalEnc(dicts=bigw.dicts)
+                payloads = [encw.encode(bigw) for _ in range(9)]
+                imw.ingest(payloads[0])   # warm dicts + jit
+                tw = time.perf_counter()
+                n = sum(imw.ingest(p)["rows"] for p in payloads[1:])
+                dtw = time.perf_counter() - tw
+                imw.close()
+                if sync is not None:
+                    dbw.close_wal()
+                return n / dtw
+            finally:
+                shutil.rmtree(tmpd, ignore_errors=True)
+
+        modes = [None, "never", "interval:1", "always"]
+        best_e2e = {m: 0.0 for m in modes}
+        best_store = {m: 0.0 for m in modes}
+        for _ in range(3):
+            for m in modes:
+                best_e2e[m] = max(best_e2e[m], wal_e2e_pass(m))
+                best_store[m] = max(best_store[m], wal_store_pass(m))
+        wal_rates = {("off" if m is None else m): round(best_e2e[m])
+                     for m in modes}
+        wal_store_rates = {("off" if m is None else m):
+                           round(best_store[m]) for m in modes}
+        if best_e2e[None] > 0:
+            wal_rates["interval1_overhead_pct"] = round(
+                (best_e2e[None] - best_e2e["interval:1"])
+                / best_e2e[None] * 100, 2)
+        print("wal e2e ingest: " + ", ".join(
+            f"{k} {v:,}" for k, v in wal_rates.items()),
+            file=sys.stderr)
+        print("wal store insert: " + ", ".join(
+            f"{k} {v:,}" for k, v in wal_store_rates.items()),
+            file=sys.stderr)
+
+        tmpd = tempfile.mkdtemp(prefix="theia-wal-bench-")
+        try:
+            dbw = _WalDb()
+            dbw.attach_wal(os.path.join(tmpd, "wal"), sync="never")
+            for _ in range(8):
+                dbw.insert_flows(bigw)
+            dbw.wal_sync()
+            dbw.close_wal()
+            db2 = _WalDb()
+            tr = time.perf_counter()
+            st_rec = db2.attach_wal(os.path.join(tmpd, "wal"),
+                                    sync="never")
+            dtr = time.perf_counter() - tr
+            wal_recovery = int(st_rec["recoveredRows"]) / dtr
+            db2.close_wal()
+            print(f"wal recovery: {wal_recovery:,.0f} rows/s "
+                  f"({st_rec['recoveredRows']} rows replayed)",
+                  file=sys.stderr)
+        finally:
+            shutil.rmtree(tmpd, ignore_errors=True)
+    except Exception as e:
+        print(f"wal bench skipped: {e}", file=sys.stderr)
+
     try:
         import contextlib
 
@@ -638,6 +743,12 @@ def run_benchmarks() -> dict:
     }
     if metrics_overhead_pct is not None:
         result["ingest_metrics_overhead_pct"] = metrics_overhead_pct
+    if wal_rates:
+        result["wal_ingest_rows_per_sec"] = wal_rates
+    if wal_store_rates:
+        result["wal_store_insert_rows_per_sec"] = wal_store_rates
+    if wal_recovery:
+        result["wal_recovery_rows_per_sec"] = round(wal_recovery)
     if e2e_stages:
         result["e2e_stages"] = e2e_stages
     if e2e_scaling:

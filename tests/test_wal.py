@@ -1,0 +1,866 @@
+"""Write-ahead log + crash-consistent recovery.
+
+The durability contract under test: an acknowledged insert survives
+kill -9 (simulated by dropping all process state and reopening from
+disk) within the sync policy's bound; a torn tail or bad-CRC segment
+is truncated/skipped without aborting recovery; the snapshot's WAL
+stamp exactly partitions records into in-snapshot vs to-replay (no
+duplicates, no loss); a successful checkpoint garbage-collects
+covered segments; and every WAL fault site (`wal.append`,
+`wal.fsync`, `wal.rotate`) plus `checkpoint.save` degrades without
+violating the contract.
+
+No test sleeps: sync policies use `always`/`never` or a manual
+`sync()`, and clocks are injectable.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from theia_tpu.data.synth import SynthConfig, generate_flows
+from theia_tpu.store import (
+    Checkpointer,
+    FlowDatabase,
+    ReplicatedFlowDatabase,
+    ShardedFlowDatabase,
+    SnapshotCorruption,
+    SyncPolicy,
+    WalError,
+    WriteAheadLog,
+)
+from theia_tpu.store.flow_store import INTEGRITY_KEY, read_snapshot
+from theia_tpu.utils import faults
+from theia_tpu.utils.faults import FaultError
+
+pytestmark = pytest.mark.wal
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+def _batch(seed, n=4, t=5):
+    return generate_flows(SynthConfig(n_series=n, points_per_series=t,
+                                      seed=seed))
+
+
+def _rows(db):
+    """Order-insensitive logical contents of the flows table: the
+    byte-parity substrate (replay order vs insert order may differ,
+    and shards/replicas hold rows in different physical orders)."""
+    data = db.flows.scan()
+    return sorted(zip(
+        data["timeInserted"].tolist(),
+        data["flowStartSeconds"].tolist(),
+        data["octetDeltaCount"].tolist(),
+        data.strings("sourceIP").tolist(),
+        data.strings("destinationIP").tolist(),
+        data.strings("sourcePodName").tolist(),
+    ))
+
+
+def _result_rows(db, table):
+    data = db.result_tables[table].scan()
+    cols = [(data.strings(n).tolist() if n in data.dicts
+             else np.asarray(data[n]).tolist())
+            for n in data.column_names]
+    return sorted(map(tuple, zip(*cols)))
+
+
+def _reopen(wal_dir, snap=None, **kw):
+    """kill -9 simulation: all process state is gone; a fresh store
+    loads the snapshot (if any) and replays the log."""
+    db = FlowDatabase.load(snap) if snap and os.path.exists(snap) \
+        else FlowDatabase()
+    stats = db.attach_wal(wal_dir, **kw)
+    return db, stats
+
+
+# -- record codec / framing ---------------------------------------------
+
+
+def test_record_roundtrip_byte_parity(tmp_path):
+    db = FlowDatabase()
+    db.attach_wal(str(tmp_path / "wal"), sync="always")
+    db.insert_flows(_batch(1))
+    db.insert_flows(_batch(2))
+    db.tadetector.insert_rows(
+        [{"id": "x", "algoType": "EWMA", "anomaly": "[1.0]"}])
+    expect = _rows(db)
+    db2, stats = _reopen(str(tmp_path / "wal"))
+    assert stats["recoveredRows"] == 41
+    assert stats["droppedRecords"] == 0
+    assert _rows(db2) == expect
+    assert _result_rows(db2, "tadetector") == \
+        _result_rows(db, "tadetector")
+    # views rebuilt by replay through the full insert path
+    assert len(db2.views["flows_pod_view"]) > 0
+    db.close_wal()
+    db2.close_wal()
+
+
+def test_sync_policy_parse():
+    assert SyncPolicy.parse("always").mode == "always"
+    assert SyncPolicy.parse("never").mode == "never"
+    p = SyncPolicy.parse("interval:2.5")
+    assert p.mode == "interval" and p.seconds == 2.5
+    assert str(p) == "interval:2.5"
+    for bad in ("sometimes", "interval:0", "interval:x", "interval:-1"):
+        with pytest.raises(ValueError):
+            SyncPolicy.parse(bad)
+
+
+def test_sync_policy_always_fsyncs_before_ack(tmp_path):
+    db = FlowDatabase()
+    db.attach_wal(str(tmp_path / "w"), sync="always")
+    db.insert_flows(_batch(1))
+    wal = db._wal
+    assert wal.synced_lsn == wal.last_lsn == 1
+    db.close_wal()
+
+
+def test_sync_policy_interval_uses_injectable_clock(tmp_path):
+    clock = [0.0]
+    wal = WriteAheadLog(str(tmp_path / "w"), sync="interval:5",
+                        clock=lambda: clock[0])
+    wal.open()
+    db = FlowDatabase()
+    applied = []
+    wal.logged_apply("flows", db.flows._adopt(_batch(1)),
+                     applied.append)
+    assert wal.synced_lsn == 0          # within the interval: no fsync
+    assert wal.stats()["lagRecords"] == 1
+    clock[0] = 6.0
+    wal.logged_apply("flows", db.flows._adopt(_batch(2)),
+                     applied.append)
+    assert wal.synced_lsn == 2          # interval elapsed → synced
+    assert len(applied) == 2
+    wal.close()
+
+
+def test_never_policy_lag_is_visible_in_stats(tmp_path):
+    db = FlowDatabase()
+    db.attach_wal(str(tmp_path / "w"), sync="never")
+    db.insert_flows(_batch(1))
+    st = db.wal_stats()
+    assert st["lagRecords"] == 1 and st["lagBytes"] > 0
+    assert st["syncedLsn"] == 0 and st["lastLsn"] == 1
+    db.wal_sync()
+    assert db.wal_stats()["lagRecords"] == 0
+    db.close_wal()
+
+
+# -- torn tail / bad CRC -------------------------------------------------
+
+
+def _segments(wal_dir):
+    return sorted(os.path.join(wal_dir, n) for n in os.listdir(wal_dir)
+                  if n.startswith("wal-") and n.endswith(".log"))
+
+
+def test_torn_tail_truncated_and_prefix_recovered(tmp_path):
+    wd = str(tmp_path / "w")
+    db = FlowDatabase()
+    db.attach_wal(wd, sync="always")
+    db.insert_flows(_batch(1))
+    acked = _rows(db)
+    db.insert_flows(_batch(2))
+    db.close_wal()
+    # tear the tail: chop the last record mid-payload
+    seg = _segments(wd)[-1]
+    size = os.path.getsize(seg)
+    with open(seg, "r+b") as f:
+        f.truncate(size - 37)
+    db2, stats = _reopen(wd)
+    assert stats["tornTail"] is True
+    assert stats["recoveredRows"] == 20    # first batch survives whole
+    assert _rows(db2) == acked
+    # the garbage is physically gone: a second replay is clean
+    db3, stats3 = _reopen(wd)
+    assert stats3["tornTail"] is False
+    assert _rows(db3) == acked
+    db2.close_wal()
+    db3.close_wal()
+
+
+def test_bad_crc_mid_segment_drops_rest_but_not_recovery(tmp_path):
+    wd = str(tmp_path / "w")
+    db = FlowDatabase()
+    # tiny segments → one record per segment
+    db.attach_wal(wd, sync="always", segment_bytes=4096)
+    for seed in (1, 2, 3):
+        db.insert_flows(_batch(seed))
+    db.close_wal()
+    segs = _segments(wd)
+    assert len(segs) >= 3
+    # flip a payload byte in the SECOND segment: recovery must drop it
+    # and still apply the third
+    with open(segs[1], "r+b") as f:
+        f.seek(200)
+        b = f.read(1)
+        f.seek(200)
+        f.write(bytes([b[0] ^ 0xFF]))
+    db2, stats = _reopen(wd)
+    assert stats["droppedRecords"] >= 1
+    assert stats["recoveredRows"] == 40    # batches 1 and 3
+    assert stats["gapped"] is True         # the hole is visible
+    db2.close_wal()
+
+
+def test_unknown_table_record_skipped(tmp_path):
+    wal = WriteAheadLog(str(tmp_path / "w"), sync="always")
+    wal.replay(lambda *a: None)
+    wal.open()
+    db = FlowDatabase()
+    wal.append("flows", db.flows._adopt(_batch(1)))
+    wal.append("no_such_table", db.flows._adopt(_batch(2)))
+    wal.close()
+    db2, stats = _reopen(str(tmp_path / "w"))
+    assert len(db2.flows) == 20            # the unknown record dropped
+    assert stats["recoveredRecords"] == 2  # decoded fine, applied 1
+    db2.close_wal()
+
+
+# -- snapshot stamp / GC -------------------------------------------------
+
+
+def test_snapshot_stamp_no_duplicates_no_loss(tmp_path):
+    wd, snap = str(tmp_path / "w"), str(tmp_path / "f.npz")
+    db = FlowDatabase()
+    db.attach_wal(wd, sync="always")
+    db.insert_flows(_batch(1))
+    stamp = db.save(snap)
+    assert stamp == 1
+    db.insert_flows(_batch(2))
+    db.tadetector.insert_rows([{"id": "j1", "algoType": "ARIMA"}])
+    expect = _rows(db)
+    db2, stats = _reopen(wd, snap)
+    assert stats["skippedRecords"] == 1    # the pre-stamp record
+    assert stats["recoveredRecords"] == 2
+    assert _rows(db2) == expect
+    assert len(db2.tadetector) == 1
+    db.close_wal()
+    db2.close_wal()
+
+
+def test_checkpoint_gcs_segments_lagged_one_generation(tmp_path):
+    """GC lags one checkpoint: segments are collected only once TWO
+    successive snapshots cover them, so the `.prev` fallback snapshot
+    always still has the log records above its own stamp."""
+    wd, snap = str(tmp_path / "w"), str(tmp_path / "f.npz")
+    db = FlowDatabase()
+    db.attach_wal(wd, sync="always", segment_bytes=4096)
+    ck = Checkpointer(db, snap, interval=3600)
+    for seed in range(1, 5):
+        db.insert_flows(_batch(seed))
+    n_before = len(_segments(wd))
+    assert n_before >= 4
+    assert ck.checkpoint() is True
+    # first checkpoint: nothing GC'd yet (no previous stamp)
+    assert len(_segments(wd)) >= n_before
+    db.insert_flows(_batch(5))
+    assert ck.checkpoint() is True
+    # second checkpoint: segments below the FIRST stamp collected
+    assert len(_segments(wd)) < n_before
+    # recovery from snapshot + surviving log is still exact
+    expect = _rows(db)
+    db2, _ = _reopen(wd, snap)
+    assert _rows(db2) == expect
+    # and recovery from the FALLBACK snapshot is too: its stamp is
+    # older, and the records above it must still be in the log
+    os.unlink(snap)
+    os.replace(snap + ".prev", snap)
+    db3, _ = _reopen(wd, snap)
+    assert _rows(db3) == expect
+    db.close_wal()
+    db2.close_wal()
+    db3.close_wal()
+
+
+def test_rotation_bounds_segment_size(tmp_path):
+    wd = str(tmp_path / "w")
+    db = FlowDatabase()
+    db.attach_wal(wd, sync="never", segment_bytes=4096)
+    for seed in range(6):
+        db.insert_flows(_batch(seed))
+    segs = _segments(wd)
+    assert len(segs) >= 6
+    # every sealed segment respects the bound (+1 oversized record
+    # allowance: a record larger than the bound still lands whole)
+    for s in segs[:-1]:
+        assert os.path.getsize(s) <= 4096 + 40 * 1024
+    db.close_wal()
+
+
+# -- fault-injected crash matrix -----------------------------------------
+
+
+def test_fault_wal_append_fails_insert_without_ack(tmp_path):
+    wd = str(tmp_path / "w")
+    db = FlowDatabase()
+    db.attach_wal(wd, sync="always")
+    db.insert_flows(_batch(1))
+    acked = _rows(db)
+    faults.arm("wal.append:error")
+    with pytest.raises(FaultError):
+        db.insert_flows(_batch(2))
+    faults.disarm()
+    # the failed insert is neither visible nor durable — no torn state
+    assert _rows(db) == acked
+    db2, stats = _reopen(wd)
+    assert _rows(db2) == acked
+    assert stats["droppedRecords"] == 0
+    db.close_wal()
+    db2.close_wal()
+
+
+def test_fault_wal_fsync_error_keeps_serving(tmp_path):
+    wd = str(tmp_path / "w")
+    db = FlowDatabase()
+    db.attach_wal(wd, sync="always")
+    faults.arm("wal.fsync:error@2")        # fail exactly the 2nd sync
+    db.insert_flows(_batch(1))
+    with pytest.raises(FaultError):
+        db.insert_flows(_batch(2))
+    faults.disarm()
+    # the append itself landed (only the fsync failed): recovery sees
+    # both batches; the contract "acked ⇒ durable" still holds because
+    # the 2nd insert was NOT acked
+    db2, stats = _reopen(wd)
+    assert stats["recoveredRecords"] == 2
+    db.close_wal()
+    db2.close_wal()
+
+
+def test_fault_wal_fsync_hang_released(tmp_path):
+    wd = str(tmp_path / "w")
+    db = FlowDatabase()
+    db.attach_wal(wd, sync="always")
+    inj = faults.arm("wal.fsync:hang", hang_seconds=30.0)
+    done = threading.Event()
+
+    def insert():
+        db.insert_flows(_batch(1))
+        done.set()
+
+    t = threading.Thread(target=insert, daemon=True)
+    t.start()
+    assert not done.wait(0.2)              # wedged on the hung fsync
+    inj.release_hangs()
+    assert done.wait(5)                    # released → completes
+    t.join(timeout=5)
+    faults.disarm()
+    assert len(db.flows) == 20
+    db.close_wal()
+
+
+def test_fault_wal_rotate_error_then_recovery(tmp_path):
+    wd = str(tmp_path / "w")
+    db = FlowDatabase()
+    db.attach_wal(wd, sync="always", segment_bytes=4096)
+    db.insert_flows(_batch(1))
+    acked = _rows(db)
+    faults.arm("wal.rotate:error")
+    with pytest.raises(FaultError):        # rotation needed → fault
+        db.insert_flows(_batch(2))
+    faults.disarm()
+    assert _rows(db) == acked              # failed insert not visible
+    db.insert_flows(_batch(3))             # log still serviceable
+    expect = _rows(db)
+    db2, _ = _reopen(wd)
+    assert _rows(db2) == expect
+    db.close_wal()
+    db2.close_wal()
+
+
+def test_fault_checkpoint_save_leaves_wal_covering(tmp_path):
+    wd, snap = str(tmp_path / "w"), str(tmp_path / "f.npz")
+    db = FlowDatabase()
+    db.attach_wal(wd, sync="always")
+    ck = Checkpointer(db, snap, interval=3600)
+    db.insert_flows(_batch(1))
+    faults.arm("checkpoint.save:error")
+    with pytest.raises(FaultError):
+        ck.checkpoint()
+    faults.disarm()
+    assert not os.path.exists(snap)
+    # no snapshot, no GC — the WAL still carries everything
+    db2, stats = _reopen(wd, snap)
+    assert _rows(db2) == _rows(db)
+    db.close_wal()
+    db2.close_wal()
+
+
+def test_unstamped_snapshot_orphans_surviving_segments(tmp_path):
+    """Lineage break: a run WITHOUT the WAL saves an unstamped
+    snapshot over a journaled store. Re-enabling the WAL must not
+    replay the surviving segments (no stamp can say which records the
+    snapshot already holds — replaying would duplicate); they are
+    quarantined as *.orphaned instead."""
+    wd, snap = str(tmp_path / "w"), str(tmp_path / "f.npz")
+    db = FlowDatabase()
+    db.attach_wal(wd, sync="always")
+    db.insert_flows(_batch(1))
+    db.close_wal()
+    # run 2: WAL off — loads nothing (no snapshot yet), writes an
+    # UNSTAMPED snapshot of its own contents
+    db2 = FlowDatabase()
+    db2.insert_flows(_batch(1))
+    db2.save(snap)
+    # run 3: WAL back on over the stale segments
+    db3 = FlowDatabase.load(snap)
+    stats = db3.attach_wal(wd, sync="always")
+    assert stats["recoveredRows"] == 0     # nothing replayed...
+    assert _rows(db3) == _rows(db2)        # ...nothing duplicated
+    assert any(n.endswith(".orphaned") for n in os.listdir(wd))
+    db3.close_wal()
+
+
+def test_failed_rotation_poisons_log_with_clear_error(tmp_path):
+    """A segment-open failure during rotation must surface as a
+    WalError naming the rotation, not a bare 'I/O operation on closed
+    file' from a stale handle on every later insert."""
+    wd = str(tmp_path / "w")
+    db = FlowDatabase()
+    db.attach_wal(wd, sync="never", segment_bytes=4096)
+    db.insert_flows(_batch(1))
+    wal = db._wal
+    orig = wal._open_segment_locked
+
+    def boom(first_lsn):
+        raise OSError("No space left on device")
+
+    wal._open_segment_locked = boom
+    with pytest.raises(WalError, match="rotation failed"):
+        db.insert_flows(_batch(2))         # triggers rotation
+    wal._open_segment_locked = orig
+    with pytest.raises(WalError, match="rotation failed"):
+        db.insert_flows(_batch(3))         # poisoned, clear error
+    db.close_wal()                         # must not raise
+
+
+def test_broken_wal_refuses_appends(tmp_path):
+    wal = WriteAheadLog(str(tmp_path / "w"), sync="never")
+    wal.replay(lambda *a: None)
+    wal.open()
+    wal._broken = "simulated poisoned log"
+    db = FlowDatabase()
+    with pytest.raises(WalError):
+        wal.append("flows", db.flows._adopt(_batch(1)))
+    wal.close()
+
+
+# -- sharded -------------------------------------------------------------
+
+
+def test_sharded_per_shard_wal_parallel_replay_parity(tmp_path):
+    wd, snap = str(tmp_path / "w"), str(tmp_path / "s.npz")
+    db = ShardedFlowDatabase(n_shards=4)
+    db.attach_wal(wd, sync="always")
+    db.insert_flows(_batch(1, n=8))
+    stamps = db.save(snap)
+    assert stamps == [db.shards[i].wal_position() for i in range(4)]
+    db.insert_flows(_batch(2, n=8))
+    db.insert_flows(_batch(3, n=8))
+    expect = _rows(db)
+    for i in range(4):
+        assert os.path.isdir(os.path.join(wd, f"shard-{i:03d}"))
+    db2 = ShardedFlowDatabase.load(snap, n_shards=4)
+    stats = db2.attach_wal(wd, sync="always")
+    assert _rows(db2) == expect
+    # determinism: a second independent replay yields identical
+    # logical contents whatever the thread interleaving did
+    db3 = ShardedFlowDatabase.load(snap, n_shards=4)
+    db3.attach_wal(wd, sync="always")
+    assert _rows(db3) == _rows(db2) == expect
+    assert stats["recoveredRows"] > 0
+    db.close_wal()
+    db2.close_wal()
+    db3.close_wal()
+
+
+def test_sharded_topology_change_adopts_stray_logs(tmp_path):
+    wd, snap = str(tmp_path / "w"), str(tmp_path / "s.npz")
+    db = ShardedFlowDatabase(n_shards=4)
+    db.attach_wal(wd, sync="always")
+    db.insert_flows(_batch(1, n=8))
+    expect = _rows(db)
+    db.close_wal()
+    # restart with FEWER shards: shard-002/003 logs must not orphan
+    db2 = ShardedFlowDatabase(n_shards=2)
+    stats = db2.attach_wal(wd, sync="always")
+    assert _rows(db2) == expect
+    assert stats.get("adoptedRows", 0) > 0
+    assert not os.path.isdir(os.path.join(wd, "shard-003"))
+    # adopted rows were RE-JOURNALED under the new topology: another
+    # crash still recovers them
+    db3 = ShardedFlowDatabase(n_shards=2)
+    db3.attach_wal(wd, sync="always")
+    assert _rows(db3) == expect
+    db2.close_wal()
+    db3.close_wal()
+
+
+# -- replicated ----------------------------------------------------------
+
+
+def test_replicated_recovery_prefers_ungapped_replica(tmp_path):
+    wd = str(tmp_path / "w")
+    db = ReplicatedFlowDatabase(replicas=2)
+    db.attach_wal(wd, sync="always")
+    db.insert_flows(_batch(1))
+    # replica 1 quarantined: writes go around it (its log gaps)
+    db.set_replica_down(1)
+    db._quarantined[1] = {"since": 0.0, "failedWrites": 1}
+    db.insert_flows(_batch(2))
+    # heal: wholesale resync + WAL reposition to the peer's LSN
+    assert db.repair_replica(1) is True
+    assert db.replicas[1].wal_position() == \
+        db.replicas[0].wal_position()
+    db.insert_flows(_batch(3))
+    expect = _rows(db.active)
+    # crash + recover: replica 1's log has a hole where the fan-out
+    # wrote around it — recovery must pick replica 0 and resync 1
+    db2 = ReplicatedFlowDatabase(replicas=2)
+    stats = db2.attach_wal(wd, sync="always")
+    assert stats["replica"] == 0
+    assert [p["gapped"] for p in stats["perReplica"]] == [False, True]
+    for r in db2.replicas:
+        assert _rows(r) == expect
+    db.close_wal()
+    db2.close_wal()
+
+
+def test_replicated_to_plain_topology_adopts_one_copy(tmp_path):
+    """replica-* logs are COPIES of the whole store: a topology change
+    to a plain store must adopt exactly one (the best), not sum them —
+    summing would duplicate every acknowledged row."""
+    wd = str(tmp_path / "w")
+    rd = ReplicatedFlowDatabase(replicas=2)
+    rd.attach_wal(wd, sync="always")
+    rd.insert_flows(_batch(1))
+    rd.insert_flows(_batch(2))
+    expect = _rows(rd.active)
+    rd.close_wal()
+    db = FlowDatabase()
+    stats = db.attach_wal(wd, sync="always")
+    assert _rows(db) == expect             # once, not once per replica
+    assert stats.get("adoptedRows") == 40
+    assert not os.path.isdir(os.path.join(wd, "replica-000"))
+    assert not os.path.isdir(os.path.join(wd, "replica-001"))
+    # adopted rows were re-journaled: another crash still recovers
+    db2, _ = _reopen(wd)
+    assert _rows(db2) == expect
+    db.close_wal()
+    db2.close_wal()
+
+
+def test_plain_to_replicated_topology_adopts_partitions(tmp_path):
+    """The reverse topology change: a plain run's log at the WAL root
+    must replay into a replicated store via the fan-out insert (every
+    replica journals it), not be silently orphaned."""
+    wd = str(tmp_path / "w")
+    db = FlowDatabase()
+    db.attach_wal(wd, sync="always")
+    db.insert_flows(_batch(1))
+    expect = _rows(db)
+    db.close_wal()
+    rd = ReplicatedFlowDatabase(replicas=2)
+    stats = rd.attach_wal(wd, sync="always")
+    assert stats.get("adoptedRows") == 20
+    for r in rd.replicas:
+        assert _rows(r) == expect
+    # adopted rows re-journaled per replica: a crash still recovers
+    rd.close_wal()
+    rd2 = ReplicatedFlowDatabase(replicas=2)
+    rd2.attach_wal(wd, sync="always")
+    assert _rows(rd2.active) == expect
+    rd2.close_wal()
+
+
+def test_replicated_restart_removes_stray_replica_copies(tmp_path):
+    """Shrinking --replicas: the stray replica dir is a redundant COPY
+    of what the live replicas recovered — removed, never replayed
+    (replaying would duplicate every row)."""
+    wd = str(tmp_path / "w")
+    rd = ReplicatedFlowDatabase(replicas=3)
+    rd.attach_wal(wd, sync="always")
+    rd.insert_flows(_batch(1))
+    expect = _rows(rd.active)
+    rd.close_wal()
+    rd2 = ReplicatedFlowDatabase(replicas=2)
+    stats = rd2.attach_wal(wd, sync="always")
+    assert "adoptedRows" not in stats      # nothing REPLAYED
+    assert _rows(rd2.active) == expect     # and nothing duplicated
+    assert not os.path.isdir(os.path.join(wd, "replica-002"))
+    rd2.close_wal()
+
+
+def test_sharded_load_falls_back_to_prev_snapshot(tmp_path):
+    """The crash window between prev-rotation and publish leaves only
+    <path>.prev; the sharded/replicated loaders must reach it (the
+    manager no longer gates load on os.path.exists(primary))."""
+    snap = str(tmp_path / "s.npz")
+    db = ShardedFlowDatabase(n_shards=2)
+    db.insert_flows(_batch(1, n=6))
+    db.save(snap)
+    db.save(snap)                          # rotates → .prev
+    os.unlink(snap)
+    db2 = ShardedFlowDatabase.load(snap, n_shards=2)
+    assert _rows(db2) == _rows(db)
+
+
+def test_segment_name_collision_starts_fresh(tmp_path):
+    """A crash right after rotation leaves a record-free segment at
+    the next LSN — possibly written by a build with a different
+    checksum algo. Reopening must start that segment over, not append
+    frames under the stale header (a later recovery would reject
+    them wholesale as checksum mismatches)."""
+    from theia_tpu.store.wal import (_SEG_HEADER, _SEG_MAGIC,
+                                     _SEG_VERSION)
+    wd = str(tmp_path / "w")
+    os.makedirs(wd)
+    with open(os.path.join(wd, f"wal-{1:016d}.log"), "wb") as f:
+        f.write(_SEG_HEADER.pack(_SEG_MAGIC, _SEG_VERSION, 1, 0, 1))
+    db = FlowDatabase()
+    db.attach_wal(wd, sync="always")       # collides with wal-...0001
+    db.insert_flows(_batch(1))
+    db.close_wal()
+    db2, stats = _reopen(wd)
+    assert stats["recoveredRows"] == 20
+    assert stats["droppedRecords"] == 0
+    db2.close_wal()
+
+
+def test_replicated_fanout_appends_to_every_live_log(tmp_path):
+    wd = str(tmp_path / "w")
+    db = ReplicatedFlowDatabase(replicas=3)
+    db.attach_wal(wd, sync="always")
+    db.insert_flows(_batch(1))
+    assert [r.wal_position() for r in db.replicas] == [1, 1, 1]
+    db.close_wal()
+
+
+# -- snapshot integrity --------------------------------------------------
+
+
+def test_snapshot_digest_roundtrip(tmp_path):
+    snap = str(tmp_path / "f.npz")
+    db = FlowDatabase()
+    db.insert_flows(_batch(1))
+    db.save(snap)
+    payload = read_snapshot(snap)
+    assert INTEGRITY_KEY in payload
+    db2 = FlowDatabase.load(snap)
+    assert _rows(db2) == _rows(db)
+
+
+def test_corrupt_snapshot_falls_back_to_prev(tmp_path):
+    snap = str(tmp_path / "f.npz")
+    db = FlowDatabase()
+    db.insert_flows(_batch(1))
+    db.save(snap)
+    db.insert_flows(_batch(2))
+    db.save(snap)                          # rotates first save → .prev
+    assert os.path.exists(snap + ".prev")
+    # corrupt the primary (truncate mid-file)
+    with open(snap, "r+b") as f:
+        f.truncate(os.path.getsize(snap) // 2)
+    db2 = FlowDatabase.load(snap)          # loud fallback, not a crash
+    assert len(db2.flows) == 20            # the .prev contents
+    from theia_tpu.obs import metrics as obs_metrics
+    m = obs_metrics.REGISTRY.get("theia_snapshot_fallbacks_total")
+    assert m is not None and m.value() >= 1
+
+
+def test_corrupt_snapshot_without_prev_raises(tmp_path):
+    snap = str(tmp_path / "f.npz")
+    db = FlowDatabase()
+    db.insert_flows(_batch(1))
+    db.save(snap)
+    os.unlink(snap + ".prev") if os.path.exists(snap + ".prev") \
+        else None
+    with open(snap, "r+b") as f:
+        f.truncate(os.path.getsize(snap) // 2)
+    with pytest.raises(Exception):         # never silently empty
+        FlowDatabase.load(snap)
+
+
+def test_missing_primary_with_prev_falls_back(tmp_path):
+    snap = str(tmp_path / "f.npz")
+    db = FlowDatabase()
+    db.insert_flows(_batch(1))
+    db.save(snap)
+    db.save(snap)                          # unchanged content, rotates
+    os.unlink(snap)                        # crash window simulation
+    db2 = FlowDatabase.load(snap)
+    assert len(db2.flows) == 20
+
+
+def test_digest_mismatch_detected(tmp_path):
+    snap = str(tmp_path / "f.npz")
+    db = FlowDatabase()
+    db.insert_flows(_batch(1))
+    db.save(snap, compress=False)
+    # surgically flip bytes inside the zip member data without
+    # breaking the container: rewrite one column with different data
+    payload = dict(np.load(snap, allow_pickle=True))
+    payload["flows/octetDeltaCount"] = \
+        payload["flows/octetDeltaCount"] + 1
+    np.savez(snap, **payload)              # stale digest retained
+    with pytest.raises(SnapshotCorruption):
+        read_snapshot(snap)
+
+
+# -- shutdown drain / janitor scoping ------------------------------------
+
+
+def test_ingest_close_drains_queued_insert_legs():
+    from theia_tpu.manager.ingest import IngestManager
+    db = FlowDatabase()
+    im = IngestManager(db, n_shards=1)
+    release = threading.Event()
+    applied = []
+
+    def slow(_batch):
+        release.wait(5)
+        applied.append(1)
+        return 1
+
+    # wedge the pool with slow inserts, then close: close must WAIT
+    futs = [im._submit_insert(slow, None) for _ in range(3)]
+    t = threading.Thread(target=im.close, daemon=True)
+    t.start()
+    release.set()
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert len(applied) == 3               # nothing dropped
+    assert all(f.done() for f in futs)
+
+
+def test_ingest_close_drain_is_bounded():
+    """A wedged store-insert leg must not hang shutdown forever —
+    close() waits up to drain_timeout, then abandons it (the request
+    was never acknowledged) so the WAL fsync + final checkpoint still
+    run."""
+    from theia_tpu.manager.ingest import IngestManager
+    db = FlowDatabase()
+    im = IngestManager(db, n_shards=1)
+    release = threading.Event()
+    im._submit_insert(lambda: release.wait(30))
+    t0 = time.monotonic()
+    im.close(drain_timeout=0.2)
+    assert time.monotonic() - t0 < 5
+    release.set()
+
+
+def test_persist_on_shutdown_skips_save_when_checkpointer_wedged(
+        tmp_path):
+    from theia_tpu.manager.__main__ import _persist_on_shutdown
+    from theia_tpu.utils import get_logger
+
+    class WedgedCheckpointer:
+        def stop(self):
+            return False
+
+    snap = str(tmp_path / "f.npz")
+    db = FlowDatabase()
+    db.attach_wal(str(tmp_path / "w"), sync="never")
+    db.insert_flows(_batch(1))
+    wrote = _persist_on_shutdown(db, snap, WedgedCheckpointer(),
+                                 get_logger("test"))
+    assert wrote is False
+    assert not os.path.exists(snap)        # racing save skipped
+    assert db._wal is None                 # but the WAL was closed...
+    db2, stats = _reopen(str(tmp_path / "w"))
+    assert stats["recoveredRows"] == 20    # ...fsynced and complete
+    db2.close_wal()
+
+
+def test_persist_on_shutdown_saves(tmp_path):
+    from theia_tpu.manager.__main__ import _persist_on_shutdown
+    from theia_tpu.utils import get_logger
+    wd, snap = str(tmp_path / "w"), str(tmp_path / "f.npz")
+    db = FlowDatabase()
+    db.attach_wal(wd, sync="always", segment_bytes=4096)
+    for seed in range(4):
+        db.insert_flows(_batch(seed))
+    assert _persist_on_shutdown(db, snap, None,
+                                get_logger("test")) is True
+    assert os.path.exists(snap)
+    db2 = FlowDatabase.load(snap)
+    stats = db2.attach_wal(wd)
+    assert stats["recoveredRows"] == 0     # snapshot covered it all
+    assert len(db2.flows) == 80
+    db2.close_wal()
+
+
+def test_checkpointer_tmp_gc_spares_wal_files(tmp_path):
+    """_gc_stale_tmp must only collect snapshot temps (.tmp-*.npz),
+    never WAL files sharing the directory."""
+    snap = str(tmp_path / "f.npz")
+    db = FlowDatabase()
+    db.attach_wal(str(tmp_path), sync="always")   # WAL in SAME dir
+    db.insert_flows(_batch(1))
+    old = time.time() - 3600
+    stale_snap = tmp_path / ".tmp-stale.npz"
+    stale_snap.write_bytes(b"dead")
+    os.utime(stale_snap, (old, old))
+    stray = tmp_path / ".tmp-walish"              # non-snapshot temp
+    stray.write_bytes(b"not a snapshot temp")
+    os.utime(stray, (old, old))
+    seg = _segments(str(tmp_path))[0]
+    os.utime(seg, (old, old))                     # aged WAL segment
+    ck = Checkpointer(db, snap, interval=3600)
+    ck._gc_stale_tmp()
+    assert not stale_snap.exists()                # snapshot temp: GONE
+    assert stray.exists()                         # out of scope: kept
+    assert os.path.exists(seg)                    # WAL: untouched
+    db2, stats = _reopen(str(tmp_path))
+    assert stats["recoveredRows"] == 20
+    db.close_wal()
+    db2.close_wal()
+
+
+# -- metrics / health -----------------------------------------------------
+
+
+def test_wal_metrics_move(tmp_path):
+    from theia_tpu.obs import metrics as obs_metrics
+    appended = obs_metrics.REGISTRY.get("theia_wal_appended_bytes_total")
+    before = appended.value() if appended else 0.0
+    db = FlowDatabase()
+    db.attach_wal(str(tmp_path / "w"), sync="always")
+    db.insert_flows(_batch(1))
+    appended = obs_metrics.REGISTRY.get("theia_wal_appended_bytes_total")
+    assert appended.value() > before
+    fsync = obs_metrics.REGISTRY.get("theia_wal_fsync_seconds")
+    assert fsync.count() >= 1
+    db.close_wal()
+
+
+def test_healthz_surfaces_wal(tmp_path):
+    from theia_tpu.manager.api import TheiaManagerServer
+    db = FlowDatabase()
+    db.attach_wal(str(tmp_path / "w"), sync="never")
+    db.insert_flows(_batch(1))
+    server = TheiaManagerServer(db, port=0, workers=1)
+    try:
+        handler = server.httpd.RequestHandlerClass
+        doc = handler._health_doc(
+            type("H", (), {"controller": server.controller,
+                           "ingest": server.ingest,
+                           "retention": server.retention})())
+        assert "wal" in doc
+        assert doc["wal"]["lastLsn"] == 1
+        assert doc["wal"]["lagRecords"] == 1
+    finally:
+        server.shutdown()
+        db.close_wal()

@@ -11,7 +11,12 @@ persisted FlowDatabase (and persists results back on shutdown). With
 --db, a background checkpointer also snapshots the store atomically
 every --checkpoint-interval seconds (default 60; 0 disables), bounding
 kill -9 data loss to one interval — the durability role the
-reference's ReplicatedMergeTree+ZooKeeper plays. TTL can also come
+reference's ReplicatedMergeTree+ZooKeeper plays. --wal-dir (or
+THEIA_WAL_DIR) additionally journals every acknowledged insert to a
+write-ahead log BEFORE it is acknowledged, tightening the loss bound
+from the checkpoint interval to the WAL sync policy (THEIA_WAL_SYNC,
+default interval:1 — see store/wal.py); on startup the snapshot is
+loaded and the log replayed above its stamp. TTL can also come
 from the THEIA_TTL_SECONDS env var (the deployment manifest sets it;
 flag wins).
 """
@@ -23,6 +28,53 @@ import os
 import signal
 import sys
 import threading
+
+
+def _persist_on_shutdown(db, db_path, checkpointer, log) -> bool:
+    """Graceful-shutdown drain tail, in the only safe order: the WAL
+    is fsynced FIRST (acknowledged rows are durable even if the final
+    save fails), then the checkpointer is stopped, then the final
+    snapshot is written and the now-covered WAL segments collected.
+    A checkpointer whose writer thread failed to stop (wedged write)
+    makes the final save unsafe — a racing late os.replace could
+    clobber the newer file with the older one; both writes are atomic
+    so nothing tears, but we skip the final save and say so (the
+    synced WAL carries the tail). Returns True when a final snapshot
+    was written."""
+    sync = getattr(db, "wal_sync", None)
+    if callable(sync):
+        try:
+            sync()
+        except Exception as e:
+            log.error("final WAL fsync failed: %s", e)
+    stopped = checkpointer.stop() if checkpointer else True
+    wrote = False
+    try:
+        if db_path:
+            if not stopped:
+                log.error(
+                    "checkpoint thread wedged; SKIPPING the final "
+                    "save (it could race the in-flight write) — the "
+                    "synced WAL covers rows since the last completed "
+                    "checkpoint")
+            else:
+                db.save(db_path)
+                wrote = True
+                # GC only up to the PREVIOUS snapshot's stamp (now in
+                # <path>.prev): collecting up to the final stamp would
+                # orphan the fallback snapshot if the file we just
+                # wrote is later found corrupt.
+                prev_stamp = getattr(checkpointer, "_gc_stamp", None)
+                gc = getattr(db, "wal_gc", None)
+                if prev_stamp is not None and callable(gc):
+                    gc(prev_stamp)
+    finally:
+        # the WAL must close (final fsync) even if the save failed —
+        # it is then the only durable copy of the tail
+        close = getattr(db, "close_wal", None)
+        if callable(close):
+            close()
+    return wrote
 
 
 def main(argv=None) -> None:
@@ -43,6 +95,12 @@ def main(argv=None) -> None:
     p.add_argument("--checkpoint-interval", type=float, default=60.0,
                    help="seconds between background snapshots of --db "
                         "(0 = only save on clean shutdown)")
+    p.add_argument("--wal-dir", default=None,
+                   help="write-ahead log directory (env THEIA_WAL_DIR; "
+                        "unset = snapshot-only durability): inserts "
+                        "are journaled before acknowledgement, so "
+                        "kill -9 loss is bounded by THEIA_WAL_SYNC "
+                        "instead of the checkpoint interval")
     p.add_argument("--workers", type=int, default=2)
     p.add_argument("--dispatch", default="thread",
                    choices=["thread", "subprocess"],
@@ -139,17 +197,31 @@ def main(argv=None) -> None:
                                            ttl_seconds=ttl)
             return FlowDatabase(ttl_seconds=ttl)
 
-        if args.db and os.path.exists(args.db):
-            db = ReplicatedFlowDatabase.load(
-                args.db, replicas=args.replicas, factory=_factory)
+        # Loads go through the loader even when the primary file is
+        # missing: read_snapshot falls back to <path>.prev (the crash
+        # window between prev-rotation and publish), and raises
+        # FileNotFoundError only when NEITHER exists — an
+        # os.path.exists() pre-check would silently start empty in
+        # that window.
+        if args.db:
+            try:
+                db = ReplicatedFlowDatabase.load(
+                    args.db, replicas=args.replicas, factory=_factory)
+            except FileNotFoundError:
+                db = ReplicatedFlowDatabase(replicas=args.replicas,
+                                            factory=_factory)
         else:
             db = ReplicatedFlowDatabase(replicas=args.replicas,
                                         factory=_factory)
     elif args.shards > 1:
-        if args.db and os.path.exists(args.db):
-            db = ShardedFlowDatabase.load(args.db,
-                                          n_shards=args.shards,
-                                          ttl_seconds=ttl)
+        if args.db:
+            try:
+                db = ShardedFlowDatabase.load(args.db,
+                                              n_shards=args.shards,
+                                              ttl_seconds=ttl)
+            except FileNotFoundError:
+                db = ShardedFlowDatabase(n_shards=args.shards,
+                                         ttl_seconds=ttl)
         else:
             db = ShardedFlowDatabase(n_shards=args.shards,
                                      ttl_seconds=ttl)
@@ -160,11 +232,32 @@ def main(argv=None) -> None:
             db = FlowDatabase(ttl_seconds=ttl)
     else:
         db = FlowDatabase(ttl_seconds=ttl)
+    wal_dir = args.wal_dir or os.environ.get("THEIA_WAL_DIR") or None
+    if wal_dir:
+        # Attach BEFORE synth seeding / serving: recovery replays the
+        # log above the snapshot stamp, then every insert is journaled
+        # pre-acknowledgement.
+        wal_stats = db.attach_wal(wal_dir)
+        print(f"WAL at {wal_dir}: recovered "
+              f"{wal_stats['recoveredRows']} rows in "
+              f"{wal_stats['recoveredRecords']} records "
+              f"({wal_stats['droppedRecords']} dropped)",
+              file=sys.stderr)
+
     if args.synth:
+        import contextlib
+
         from ..data.synth import SynthConfig, generate_flows
-        db.insert_flows(generate_flows(SynthConfig(
-            n_series=args.synth, points_per_series=30,
-            anomaly_fraction=0.1)))
+        # Demo seed rows are NOT journaled: a journaled seed would be
+        # replayed at the next startup and then seeded again — one
+        # extra seed per restart. (They still reach snapshots; demo
+        # data does not need kill -9 durability.)
+        suspended = getattr(db, "wal_suspended", None)
+        with (suspended() if callable(suspended)
+              else contextlib.nullcontext()):
+            db.insert_flows(generate_flows(SynthConfig(
+                n_series=args.synth, points_per_series=30,
+                anomaly_fraction=0.1)))
 
     server = TheiaManagerServer(
         db, port=args.port if args.port is not None else API_PORT,
@@ -216,16 +309,18 @@ def main(argv=None) -> None:
     signal.signal(signal.SIGINT, stop)
     signal.signal(signal.SIGTERM, stop)
     server.serve_forever()
+    # Ordered drain: the HTTP server is already closed (no NEW ingest
+    # or job submissions), so: finish reconciliation, drain in-flight
+    # jobs, then shut the server stack down — which now WAITS for the
+    # ingest insert pool (queued store-insert legs were acknowledged
+    # work; dropping them on SIGTERM violated the durability
+    # contract) — and only then fsync the WAL and take the final
+    # checkpoint.
     if reconciler:
         reconciler.stop()
-    # Drain in-flight jobs before persisting so their result rows make
-    # it into the saved file.
     server.controller.wait_all(timeout=60)
     server.shutdown()
-    if checkpointer:
-        checkpointer.stop()
-    if args.db:
-        db.save(args.db)
+    _persist_on_shutdown(db, args.db, checkpointer, log)
 
 
 if __name__ == "__main__":

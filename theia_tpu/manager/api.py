@@ -472,6 +472,17 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
                 doc["status"] = "degraded"
         if self.retention is not None:
             doc["retention"] = self.retention.stats()
+        # WAL health: segment count/bytes and the ack-durability lag
+        # (records/bytes appended but not yet fsynced under the sync
+        # policy) — the operator's read on the current loss bound.
+        wal_stats = getattr(db, "wal_stats", None)
+        if callable(wal_stats):
+            try:
+                ws = wal_stats()
+            except Exception:
+                ws = None
+            if ws:
+                doc["wal"] = ws
         armed = _faults.armed_sites()
         if armed:
             doc["faults"] = {"armed": armed}

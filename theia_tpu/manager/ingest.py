@@ -250,9 +250,48 @@ class IngestManager:
             max_workers=min(MAX_STREAMS,
                             max(4, 2 * (os.cpu_count() or 1))),
             thread_name_prefix="theia-ingest-insert")
+        # In-flight store-insert legs, tracked so close() can drain
+        # them with a BOUND (ThreadPoolExecutor.shutdown(wait=True)
+        # has none, and one wedged insert must not hang SIGTERM
+        # forever past the WAL-fsync/final-checkpoint steps).
+        self._inflight_lock = threading.Lock()
+        self._inflight: set = set()
 
-    def close(self) -> None:
-        """Release the pipelining pool's threads (idempotent)."""
+    def _submit_insert(self, fn, *args):
+        fut = self._insert_pool.submit(fn, *args)
+        with self._inflight_lock:
+            self._inflight.add(fut)
+        fut.add_done_callback(self._discard_inflight)
+        return fut
+
+    def _discard_inflight(self, fut) -> None:
+        with self._inflight_lock:
+            self._inflight.discard(fut)
+
+    def close(self, drain: bool = True,
+              drain_timeout: float = 60.0) -> None:
+        """Release the pipelining pool's threads (idempotent). By
+        default DRAINS queued/in-flight store-insert legs first —
+        those rows belong to acknowledged (or about-to-be-
+        acknowledged) requests, and the old shutdown(wait=False)
+        dropped them on SIGTERM, exactly the loss the durability
+        contract forbids — but with a bound: a wedged insert (hung
+        store, fault drill) must not stall shutdown past the WAL
+        fsync and final checkpoint. `drain=False` is for tests
+        tearing down a deliberately wedged pool."""
+        if drain:
+            import concurrent.futures as _cf
+            with self._inflight_lock:
+                pending = list(self._inflight)
+            if pending:
+                done, not_done = _cf.wait(pending,
+                                          timeout=drain_timeout)
+                if not_done:
+                    logger.error(
+                        "%d store-insert legs still running after "
+                        "%.0fs drain; abandoning them (their "
+                        "requests were never acknowledged)",
+                        len(not_done), drain_timeout)
         self._insert_pool.shutdown(wait=False)
 
     def _stream(self, stream_id: str) -> _Stream:
@@ -333,7 +372,7 @@ class IngestManager:
         # batch's alerts are still withheld (published only after the
         # insert leg succeeds, below), and the store itself stays
         # exactly-once.
-        fut = self._insert_pool.submit(self._timed_insert, batch)
+        fut = self._submit_insert(self._timed_insert, batch)
         try:
             t_det = time.perf_counter()
             alerts, conn_alerts, n_conn = self.score_batch(batch)

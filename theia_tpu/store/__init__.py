@@ -2,18 +2,23 @@
 
 from .checkpoint import Checkpointer
 from .flow_store import (FlowDatabase, RetentionLoop, RetentionMonitor,
-                         Table)
+                         SnapshotCorruption, Table, read_snapshot,
+                         write_snapshot)
 from .replicated import (AllReplicasDownError, ReplicaRepairLoop,
                          ReplicatedFlowDatabase)
 from .sharded import (DistributedTable, DistributedView,
                       ShardedFlowDatabase)
 from .views import (MATERIALIZED_VIEWS, ViewSpec, ViewTable, group_reduce,
                     group_sum)
+from .wal import (SyncPolicy, WalCorruption, WalError, WriteAheadLog,
+                  default_sync_policy)
 
 __all__ = [
     "AllReplicasDownError", "Checkpointer", "FlowDatabase",
     "ReplicaRepairLoop", "ReplicatedFlowDatabase",
-    "RetentionLoop", "RetentionMonitor", "Table",
+    "RetentionLoop", "RetentionMonitor", "SnapshotCorruption", "Table",
     "DistributedTable", "DistributedView", "ShardedFlowDatabase",
     "MATERIALIZED_VIEWS", "ViewSpec", "ViewTable", "group_reduce", "group_sum",
+    "SyncPolicy", "WalCorruption", "WalError", "WriteAheadLog",
+    "default_sync_policy", "read_snapshot", "write_snapshot",
 ]

@@ -51,6 +51,12 @@ class Checkpointer:
         self.last_error: Optional[str] = None
         self._last_fingerprint: Optional[Tuple] = (
             self._fingerprint() if assume_current else None)
+        #: WAL stamp of the PREVIOUS successful snapshot — GC lags one
+        #: checkpoint so the `.prev` fallback snapshot always still
+        #: has the log records above ITS stamp (collecting up to the
+        #: current stamp would orphan .prev the moment the primary
+        #: corrupts)
+        self._gc_stamp = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -67,7 +73,10 @@ class Checkpointer:
         (a kill -9 mid-write leaves a near-snapshot-size .tmp-*; a
         crash-looping manager would otherwise leak one per cycle until
         the volume fills). Age-gated so a concurrent writer's live
-        temp file is never collected."""
+        temp file is never collected, and scoped to SNAPSHOT temps
+        (.tmp-*.npz) only: THEIA_WAL_DIR may share this directory, and
+        the WAL's own files must never be collected by the snapshot
+        janitor."""
         d = os.path.dirname(os.path.abspath(self.path)) or "."
         now = time.time()
         try:
@@ -75,7 +84,7 @@ class Checkpointer:
         except OSError:
             return
         for name in names:
-            if not name.startswith(".tmp-"):
+            if not (name.startswith(".tmp-") and name.endswith(".npz")):
                 continue
             p = os.path.join(d, name)
             try:
@@ -124,15 +133,27 @@ class Checkpointer:
     def checkpoint(self) -> bool:
         """Write one snapshot (FlowDatabase.save is itself atomic:
         temp file + rename); returns False when skipped (unchanged
-        since the last write)."""
+        since the last write). A successful stamped snapshot then
+        garbage-collects WAL segments wholly below the PREVIOUS
+        snapshot's stamp — covered by two generations, so recovery
+        keeps working from `<path>.prev` if the primary is later
+        found corrupt — bounding disk use to ~two checkpoint
+        intervals of log."""
         fp = self._fingerprint()
         if fp == self._last_fingerprint:
             return False
         _fire_fault("checkpoint.save", path=self.path)
-        self.db.save(self.path, compress=self.compress)
+        stamp = self.db.save(self.path, compress=self.compress)
         self._last_fingerprint = fp
         self.checkpoints_written += 1
         self.last_checkpoint_time = time.time()
+        gc = getattr(self.db, "wal_gc", None)
+        if self._gc_stamp is not None and callable(gc):
+            try:
+                gc(self._gc_stamp)
+            except Exception as e:   # GC failure must not fail the tick
+                logger.error("WAL gc after checkpoint failed: %s", e)
+        self._gc_stamp = stamp
         logger.v(1).info("checkpoint %d written to %s",
                          self.checkpoints_written, self.path)
         return True

@@ -20,10 +20,13 @@ keeping the read path for dashboards O(view rows), not O(flow rows).
 from __future__ import annotations
 
 import concurrent.futures
+import contextlib
 import os
+import tempfile
 import threading
 import time
-from typing import Dict, List, Mapping, Optional, Sequence
+import zlib
+from typing import Callable, Dict, List, Mapping, Optional, Sequence
 
 import numpy as np
 
@@ -80,6 +83,18 @@ _M_RET_ROUNDS = _metrics.counter(
 _M_RET_DELETED = _metrics.counter(
     "theia_retention_rows_deleted_total",
     "Flow rows trimmed by capacity-based retention rounds")
+_M_SNAP_FALLBACK = _metrics.counter(
+    "theia_snapshot_fallbacks_total",
+    "Snapshot loads that failed verification on the primary file and "
+    "fell back to the previous good snapshot (<path>.prev)")
+
+#: snapshot payload keys outside the table namespace
+WAL_LSNS_KEY = "__wal__/lsns"
+INTEGRITY_KEY = "__integrity__/crc32"
+
+
+class SnapshotCorruption(Exception):
+    """A snapshot file failed integrity verification."""
 
 
 def _view_pool() -> concurrent.futures.ThreadPoolExecutor:
@@ -120,6 +135,11 @@ class Table:
         self._adopt_maps: Dict[str, DictionaryMapper] = {
             name: DictionaryMapper(d) for name, d in self.dicts.items()}
         self._adopt_lock = threading.Lock()
+        # Durability hook, installed by FlowDatabase.attach_wal:
+        # called as hook(table_name, adopted, apply_fn) so the WAL can
+        # journal the store-coded batch BEFORE apply_fn makes it
+        # visible (and the caller acknowledges it). None = no WAL.
+        self._wal_hook: Optional[Callable] = None
 
     def __len__(self) -> int:
         return sum(len(b) for b in self._batches)
@@ -152,17 +172,28 @@ class Table:
     def insert(self, batch: ColumnarBatch) -> Optional[ColumnarBatch]:
         """Insert a batch; returns the adopted (store-coded) batch, or
         None when empty, so callers can fan out the exact inserted block
-        without re-reading the append log under concurrency."""
+        without re-reading the append log under concurrency. With a
+        WAL attached, the record is journaled before the rows become
+        visible — a failed append fails the insert (no ack without
+        durability)."""
         if len(batch) == 0:
             return None
         adopted = self._adopt(batch)
+        hook = self._wal_hook
+        if hook is None:
+            self._append_adopted(adopted)
+        else:
+            hook(self.name, adopted, self._append_adopted)
+        return adopted
+
+    def _append_adopted(self, adopted: ColumnarBatch) -> None:
+        """Make an already-adopted batch visible (the memory apply)."""
         nbytes = sum(a.nbytes for a in adopted.columns.values())
         with self._lock:
             self._batches.append(adopted)
             self.generation += 1
             self.rows_inserted_total += len(adopted)
             self.bytes_inserted_total += nbytes
-        return adopted
 
     def insert_rows(self, rows: Sequence[Mapping[str, object]]) -> int:
         if not rows:
@@ -430,6 +461,104 @@ class RetentionLoop:
         }
 
 
+def payload_digest(payload: Mapping[str, np.ndarray]) -> int:
+    """Content checksum over a snapshot payload (every key except the
+    integrity stamp itself) — defense in depth over the zip
+    container's per-member CRCs: one whole-payload value that covers
+    cross-member consistency (a member replaced or dropped with the
+    container left valid) and survives a future non-zip snapshot
+    format. Object (string-table) arrays hash their joined utf-8
+    contents in one pass, so the digest is stable across a save/load
+    round trip and costs far less than the compression beside it."""
+    crc = 0
+    for key in sorted(payload):
+        if key == INTEGRITY_KEY:
+            continue
+        arr = np.asarray(payload[key])
+        crc = zlib.crc32(key.encode("utf-8"), crc)
+        if arr.dtype == object:
+            blob = "\x1f".join(map(str, arr.reshape(-1).tolist()))
+            crc = zlib.crc32(blob.encode("utf-8", "surrogatepass"),
+                             crc)
+        else:
+            crc = zlib.crc32(arr.dtype.str.encode("ascii"), crc)
+            crc = zlib.crc32(np.ascontiguousarray(arr).tobytes(), crc)
+    return crc & 0xFFFFFFFF
+
+
+def write_snapshot(path: str, payload: Dict[str, np.ndarray],
+                   compress: bool = True,
+                   wal_lsns: Optional[Sequence[int]] = None) -> None:
+    """Publish a snapshot: stamp schema version, WAL LSNs, and an
+    integrity footer; write to a same-directory temp file; keep the
+    previous good snapshot as `<path>.prev`; then atomically replace.
+    A crash at ANY point leaves either the previous or the new
+    complete snapshot reachable (possibly only as .prev — the loader
+    falls back)."""
+    from .migration import CURRENT_SCHEMA_VERSION, force
+    force(payload, CURRENT_SCHEMA_VERSION)
+    if wal_lsns is not None:
+        payload[WAL_LSNS_KEY] = np.asarray(list(wal_lsns), np.int64)
+    payload[INTEGRITY_KEY] = np.asarray(payload_digest(payload),
+                                        np.int64)
+    writer = np.savez_compressed if compress else np.savez
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".tmp-", suffix=".npz")
+    os.close(fd)
+    try:
+        writer(tmp, **payload)
+        if os.path.exists(path):
+            os.replace(path, path + ".prev")
+        os.replace(tmp, path)
+    except BaseException:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise
+
+
+def read_snapshot(path: str) -> Dict[str, np.ndarray]:
+    """Load + verify a snapshot. A primary that fails verification
+    (bad zip, short file, digest mismatch) falls back — loudly, with
+    a metric — to `<path>.prev` instead of crashing or silently
+    starting empty; FileNotFoundError propagates only when neither
+    file exists (the caller's fresh-start signal)."""
+    def _load(p: str) -> Dict[str, np.ndarray]:
+        with np.load(p, allow_pickle=True) as z:
+            payload = {k: z[k] for k in z.files}
+        stored = payload.get(INTEGRITY_KEY)
+        if stored is not None and \
+                int(np.asarray(stored)) != payload_digest(payload):
+            raise SnapshotCorruption(
+                f"snapshot {p} failed integrity verification "
+                f"(digest mismatch)")
+        return payload
+
+    prev = path + ".prev"
+    try:
+        return _load(path)
+    except FileNotFoundError:
+        if os.path.exists(prev):
+            _logger.error(
+                "snapshot %s missing but %s exists (crash between "
+                "prev-rotation and publish?) — loading the previous "
+                "snapshot", path, prev)
+            _M_SNAP_FALLBACK.inc()
+            return _load(prev)
+        raise
+    except Exception as e:
+        if os.path.exists(prev):
+            _logger.error(
+                "snapshot %s failed verification (%s: %s) — falling "
+                "back to previous good snapshot %s",
+                path, type(e).__name__, e, prev)
+            _M_SNAP_FALLBACK.inc()
+            try:
+                return _load(prev)
+            except Exception:
+                raise e
+        raise
+
+
 class FlowDatabase:
     """The full database: flows + views + result tables + retention.
 
@@ -452,6 +581,12 @@ class FlowDatabase:
             name: ViewTable(name, spec, self.flows.dicts)
             for name, spec in MATERIALIZED_VIEWS.items()}
         self.ttl_seconds = ttl_seconds
+        #: attached WriteAheadLog (None = snapshot-only durability)
+        self._wal = None
+        #: per-log WAL stamps read from the loaded snapshot (empty =
+        #: fresh store or pre-WAL snapshot); attach_wal replays above
+        #: these
+        self._snapshot_lsns: List[int] = []
 
     # -- ingest ------------------------------------------------------------
 
@@ -505,6 +640,130 @@ class FlowDatabase:
     def bytes_inserted_total(self) -> int:
         return self.flows.bytes_inserted_total
 
+    # -- write-ahead log ---------------------------------------------------
+
+    def attach_wal(self, wal_dir: str, sync: Optional[str] = None,
+                   segment_bytes: Optional[int] = None
+                   ) -> Dict[str, object]:
+        """Recover from and then journal into a WAL at `wal_dir`:
+        replay surviving records above the loaded snapshot's stamp,
+        open the append side, install the insert-path hooks, and adopt
+        any log content left by a different store topology. Returns
+        the replay stats."""
+        stamps = self._snapshot_lsns
+        stats = self._attach_wal_at(
+            wal_dir, stamps[0] if stamps else 0, sync, segment_bytes)
+        from .wal import adopt_foreign_wal_dirs
+        adopted = adopt_foreign_wal_dirs(self, wal_dir, [wal_dir],
+                                         stamps)
+        if adopted:
+            stats["adoptedRows"] = adopted
+        return stats
+
+    def _attach_wal_at(self, wal_dir: str, stamp: int,
+                       sync: Optional[str] = None,
+                       segment_bytes: Optional[int] = None
+                       ) -> Dict[str, object]:
+        """Core attach (no foreign-topology scan): replay → open →
+        hook. Split out so ShardedFlowDatabase can attach one log per
+        shard with per-shard stamps."""
+        from .wal import WriteAheadLog, orphan_segments
+        if self._wal is not None:
+            raise RuntimeError("WAL already attached")
+        if stamp <= 0 and (len(self.flows) or any(
+                len(t) for t in self.result_tables.values())):
+            # Lineage break: this store holds rows from a snapshot
+            # that carries NO WAL stamp (saved by a run with the WAL
+            # off), yet segments survive here. No LSN can partition
+            # those records into in-snapshot vs to-replay — replaying
+            # them would duplicate rows — so quarantine them for the
+            # operator instead.
+            orphaned = orphan_segments(wal_dir)
+            if orphaned:
+                _logger.error(
+                    "WAL %s: %d segments predate an UNSTAMPED "
+                    "snapshot (a run without --wal-dir saved over a "
+                    "journaled store); renamed to *.orphaned instead "
+                    "of replaying them into rows the snapshot may "
+                    "already hold", wal_dir, len(orphaned))
+        wal = WriteAheadLog(wal_dir, sync=sync,
+                            segment_bytes=segment_bytes)
+        stats = wal.replay(self._replay_record, above_lsn=stamp)
+        wal.open(min_next_lsn=stamp + 1)
+        self._wal = wal
+        for t in (self.flows, *self.result_tables.values()):
+            t._wal_hook = wal.logged_apply
+        return stats
+
+    def _replay_record(self, table: str, batch) -> None:
+        """Apply one recovered WAL record. Runs before the hooks are
+        installed, so nothing re-journals; flows go through the full
+        insert path (views, TTL) exactly like live ingest."""
+        if table == "flows":
+            self.insert_flows(batch)
+        elif table in self.result_tables:
+            self.result_tables[table].insert(batch)
+        else:
+            _logger.error("WAL record for unknown table %r dropped "
+                          "(%d rows)", table, len(batch))
+
+    @contextlib.contextmanager
+    def wal_suspended(self):
+        """Temporarily disable journaling (replica resync re-inserts
+        state that is already durable on the peer — re-logging it
+        would corrupt the LSN sequence)."""
+        tables = (self.flows, *self.result_tables.values())
+        saved = [t._wal_hook for t in tables]
+        for t in tables:
+            t._wal_hook = None
+        try:
+            yield
+        finally:
+            for t, hook in zip(tables, saved):
+                t._wal_hook = hook
+
+    def wal_stats(self) -> Optional[Dict[str, object]]:
+        wal = self._wal
+        return None if wal is None else wal.stats()
+
+    def wal_position(self) -> Optional[int]:
+        """Last appended LSN (None when no WAL attached)."""
+        wal = self._wal
+        return None if wal is None else wal.last_lsn
+
+    def wal_reposition(self, position) -> None:
+        """Jump the log forward to a resync peer's position."""
+        wal = self._wal
+        if wal is not None and position is not None:
+            if isinstance(position, (list, tuple)):
+                position = position[0] if position else 0
+            wal.reposition(int(position))
+
+    def wal_sync(self) -> None:
+        wal = self._wal
+        if wal is not None:
+            wal.sync()
+
+    def wal_gc(self, stamp) -> int:
+        """GC segments wholly covered by a snapshot stamped at
+        `stamp` (the value save() returned)."""
+        wal = self._wal
+        if wal is None or stamp is None:
+            return 0
+        if isinstance(stamp, (list, tuple)):
+            stamp = stamp[0] if stamp else 0
+        return wal.gc_below(int(stamp))
+
+    def close_wal(self) -> None:
+        """Final fsync + detach (part of graceful shutdown)."""
+        wal = self._wal
+        if wal is None:
+            return
+        for t in (self.flows, *self.result_tables.values()):
+            t._wal_hook = None
+        self._wal = None
+        wal.close()
+
     # -- retention ---------------------------------------------------------
 
     def evict_ttl(self, now: int) -> int:
@@ -535,17 +794,38 @@ class FlowDatabase:
     # -- persistence -------------------------------------------------------
 
     def save(self, path: str, tables: Optional[Sequence[str]] = None,
-             compress: bool = True) -> None:
+             compress: bool = True) -> Optional[int]:
         """Persist tables to one .npz (columns + dictionary tables),
         stamped with the current schema version (store/migration.py).
 
         `tables` restricts the snapshot (e.g. result tables only for a
         job's write-back); `compress=False` trades disk for CPU —
         right for short-lived job snapshots, wrong for durable
-        checkpoints. The write is ATOMIC (temp file + rename): a crash
-        mid-save never tears an existing snapshot."""
-        from ..utils import atomic_write
-        from .migration import CURRENT_SCHEMA_VERSION, force
+        checkpoints. The write is ATOMIC (temp file + rename) and
+        keeps the previous snapshot as `<path>.prev`: a crash mid-save
+        never tears an existing snapshot, and a later-corrupted
+        primary still has a verified fallback.
+
+        With a WAL attached, a FULL snapshot quiesces appends while it
+        stamps the log position and scans the tables (so the stamp is
+        exact), and returns that stamp — the caller passes it to
+        `wal_gc()` once the snapshot is known durable. Partial
+        (tables=...) snapshots stamp nothing: they are not recovery
+        points."""
+        wal = self._wal
+        if wal is not None and tables is None:
+            with wal.quiesce():
+                stamp = wal.last_lsn
+                payload = self._snapshot_payload(tables)
+        else:
+            stamp = None
+            payload = self._snapshot_payload(tables)
+        write_snapshot(path, payload, compress=compress,
+                       wal_lsns=[stamp] if stamp is not None else None)
+        return stamp
+
+    def _snapshot_payload(self, tables: Optional[Sequence[str]] = None
+                          ) -> Dict[str, np.ndarray]:
         payload: Dict[str, np.ndarray] = {}
         for table in (self.flows, *self.result_tables.values()):
             if tables is not None and table.name not in tables:
@@ -556,10 +836,7 @@ class FlowDatabase:
             for name, d in table.dicts.items():
                 payload[f"{table.name}/__dict__/{name}"] = np.asarray(
                     d._strings, dtype=object)
-        force(payload, CURRENT_SCHEMA_VERSION)
-        writer = np.savez_compressed if compress else np.savez
-        atomic_write(path, lambda tmp: writer(tmp, **payload),
-                     suffix=".npz")
+        return payload
 
     @classmethod
     def load(cls, path: str,
@@ -574,8 +851,10 @@ class FlowDatabase:
         and would otherwise pay the O(rows) view build twice."""
         from .migration import migrate
         db = cls(ttl_seconds=None)
-        with np.load(path, allow_pickle=True) as z:
-            payload = {k: z[k] for k in z.files}
+        payload = read_snapshot(path)
+        if WAL_LSNS_KEY in payload:
+            db._snapshot_lsns = [
+                int(v) for v in np.asarray(payload[WAL_LSNS_KEY])]
         migrate(payload)
         for table in (db.flows, *db.result_tables.values()):
             cols: Dict[str, np.ndarray] = {}

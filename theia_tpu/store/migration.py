@@ -164,6 +164,12 @@ def migrate(payload: Payload,
     Runs up- or down-migrators in order (main.go startMigration)."""
     if not 1 <= target <= CURRENT_SCHEMA_VERSION:
         raise ValueError(f"unknown schema version {target}")
+    # Migration mutates the payload, so any integrity stamp written by
+    # flow_store.write_snapshot no longer matches; drop it rather than
+    # let a re-saved migrated payload fail verification. (Verification
+    # runs BEFORE migration on load, so nothing is lost here.)
+    from .flow_store import INTEGRITY_KEY
+    payload.pop(INTEGRITY_KEY, None)
     version = payload_version(payload)
     if version > CURRENT_SCHEMA_VERSION:
         raise ValueError(

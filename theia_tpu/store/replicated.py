@@ -36,6 +36,8 @@ set_replica_down are operator intent and are never re-admitted by it.
 
 from __future__ import annotations
 
+import contextlib
+import os
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
@@ -286,17 +288,33 @@ class ReplicatedFlowDatabase:
 
     @staticmethod
     def _resync(stale, peer) -> None:
-        stale.flows.truncate()
-        for view in stale.views.values():
-            view.truncate()
-        flows = peer.flows.scan()
-        if len(flows):
-            stale.insert_flows(flows)
-        for name, table in stale.result_tables.items():
-            table.truncate()
-            data = peer.result_tables[name].scan()
-            if len(data):
-                table.insert(data)
+        # Journaling is suspended for the wholesale copy: every row
+        # re-inserted here is already durable in the PEER's log, and
+        # re-logging it would corrupt the stale replica's LSN
+        # sequence. Afterwards the stale replica's WAL jumps to the
+        # peer's position ("replays its peers' WAL position"): its
+        # memory now reflects everything up to that LSN, so appends
+        # continue above it — the gap this leaves is why recovery
+        # prefers an ungapped replica until the next checkpoint GCs
+        # the stale segments.
+        with contextlib.ExitStack() as stack:
+            if hasattr(stale, "wal_suspended"):
+                stack.enter_context(stale.wal_suspended())
+            stale.flows.truncate()
+            for view in stale.views.values():
+                view.truncate()
+            flows = peer.flows.scan()
+            if len(flows):
+                stale.insert_flows(flows)
+            for name, table in stale.result_tables.items():
+                table.truncate()
+                data = peer.result_tables[name].scan()
+                if len(data):
+                    table.insert(data)
+        pos = peer.wal_position() if hasattr(peer, "wal_position") \
+            else None
+        if pos is not None:
+            stale.wal_reposition(pos)
 
     # -- writes (fan-out) --------------------------------------------------
 
@@ -377,6 +395,100 @@ class ReplicatedFlowDatabase:
             lambda r: r.delete_flows_older_than(boundary),
             "delete_flows_older_than")
 
+    # -- write-ahead log ---------------------------------------------------
+
+    def attach_wal(self, wal_dir: str, sync=None,
+                   segment_bytes=None) -> Dict[str, object]:
+        """One WAL per replica under `<wal_dir>/replica-NNN`. Each
+        replica first recovers from its own log; then every replica is
+        resynced from the BEST-recovered one — most rows behind a
+        contiguous (ungapped) log — because a replica that was
+        quarantined before the crash carries a gap where the fan-out
+        wrote around it, and recovering from a gapped log would
+        silently resurrect a stale copy. The survivors' resync also
+        jumps their logs to the best replica's position (the runtime
+        repair path's discipline, applied at startup)."""
+        per: List[Dict[str, object]] = []
+        for i, r in enumerate(self.replicas):
+            per.append(r.attach_wal(
+                os.path.join(wal_dir, f"replica-{i:03d}"),
+                sync=sync, segment_bytes=segment_bytes))
+
+        def _pos(s) -> int:
+            last = s["lastLsn"]
+            return (sum(last) if isinstance(last, (list, tuple))
+                    else int(last))
+
+        best = max(range(len(per)), key=lambda i: (
+            not per[i]["gapped"], _pos(per[i]),
+            int(per[i]["recoveredRows"])))
+        peer = self.replicas[best]
+        for i, r in enumerate(self.replicas):
+            if i == best:
+                continue
+            # the common clean restart: every replica recovered the
+            # same ungapped log to the same position — already
+            # identical, a wholesale copy would be pure waste
+            if not per[i]["gapped"] \
+                    and _pos(per[i]) == _pos(per[best]) \
+                    and per[i]["recoveredRows"] == \
+                    per[best]["recoveredRows"]:
+                continue
+            self._resync(r, peer)
+        stats = dict(per[best])
+        stats["replica"] = best
+        stats["perReplica"] = per
+        if any(i != best and _pos(per[i]) != _pos(per[best])
+               for i in range(len(per))):
+            logger.warning(
+                "replica WALs recovered to different positions; all "
+                "replicas resynced from replica %d (%d rows)",
+                best, int(per[best]["recoveredRows"]))
+        # Foreign topology content (a previous plain/sharded run's
+        # logs in the same --wal-dir, or replica dirs beyond our
+        # count) — partitions replay through the fan-out insert so
+        # every replica journals them; stray replica COPIES are
+        # redundant with what our own replicas just recovered and are
+        # only removed (or kept, loudly, if somehow ahead).
+        from .wal import adopt_foreign_wal_dirs
+        own = [os.path.join(wal_dir, f"replica-{i:03d}")
+               for i in range(len(self.replicas))]
+        stamps = getattr(self.replicas[0], "_snapshot_lsns", [])
+        adopted = adopt_foreign_wal_dirs(
+            self, wal_dir, own, list(stamps),
+            replica_copies=False, own_position=_pos(per[best]))
+        if adopted:
+            stats["adoptedRows"] = adopted
+        return stats
+
+    @contextlib.contextmanager
+    def wal_suspended(self):
+        """Suspend journaling on EVERY replica (the __getattr__ proxy
+        would reach only the active one; a fan-out write during the
+        suspension must not be journaled by the others either)."""
+        with contextlib.ExitStack() as stack:
+            for r in self.replicas:
+                if hasattr(r, "wal_suspended"):
+                    stack.enter_context(r.wal_suspended())
+            yield
+
+    def wal_stats(self) -> Optional[Dict[str, object]]:
+        return self.active.wal_stats()
+
+    def wal_sync(self) -> None:
+        for r in self.live():
+            r.wal_sync()
+
+    def wal_gc(self, stamp) -> int:
+        # live replicas advance in LSN lockstep (same fan-out
+        # sequence; resync repositions), so the active's snapshot
+        # stamp covers every live log
+        return sum(r.wal_gc(stamp) for r in self.live())
+
+    def close_wal(self) -> None:
+        for r in self.replicas:
+            r.close_wal()
+
     # -- reads / passthrough ----------------------------------------------
 
     def monitor(self, capacity_bytes: int, **kw):
@@ -402,6 +514,10 @@ class ReplicatedFlowDatabase:
         db = cls(replicas=replicas, ttl_seconds=ttl_seconds, **kw)
         saved_ttls = [_suspend_ttl(r) for r in db.replicas]
         single = FlowDatabase.load(path, build_views=False)
+        for r in db.replicas:
+            # every replica starts at the snapshot's WAL stamp, so a
+            # later attach_wal replays only records above it
+            r._snapshot_lsns = list(single._snapshot_lsns)
         flows = single.flows.scan()
         if len(flows):
             db.insert_flows(flows)

@@ -32,9 +32,12 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from ..schema import ColumnarBatch
+from ..utils.logging import get_logger
 from ..utils.pool import get_pool
-from .flow_store import FlowDatabase, RetentionMonitor
+from .flow_store import FlowDatabase, RetentionMonitor, write_snapshot
 from .views import MATERIALIZED_VIEWS, group_sum, materialize_view_batch
+
+_logger = get_logger("sharded")
 
 
 def _shard_pool() -> concurrent.futures.ThreadPoolExecutor:
@@ -225,6 +228,9 @@ class ShardedFlowDatabase:
             name: DistributedView(name,
                                   [s.views[name] for s in self.shards])
             for name in MATERIALIZED_VIEWS}
+        #: per-shard WAL stamps from the loaded snapshot (see
+        #: FlowDatabase._snapshot_lsns)
+        self._snapshot_lsns: List[int] = []
 
     @property
     def n_shards(self) -> int:
@@ -268,6 +274,106 @@ class ShardedFlowDatabase:
         return self.insert_flows(
             ColumnarBatch.from_rows(rows, FLOW_SCHEMA), now=now)
 
+    # -- write-ahead log --------------------------------------------------
+
+    def attach_wal(self, wal_dir: str, sync: Optional[str] = None,
+                   segment_bytes: Optional[int] = None
+                   ) -> Dict[str, object]:
+        """One WAL per shard under `<wal_dir>/shard-NNN`, recovered in
+        PARALLEL (shards are fully independent stores, so their
+        replays never interact — determinism is per-shard log order).
+        Stray logs from a different shard count (topology change
+        across restarts) are adopted through the logical insert path
+        so acknowledged rows are never orphaned."""
+        stamps = self._snapshot_lsns
+        dirs = [os.path.join(wal_dir, f"shard-{i:03d}")
+                for i in range(self.n_shards)]
+
+        def _attach(i: int) -> Dict[str, object]:
+            return self.shards[i]._attach_wal_at(
+                dirs[i], stamps[i] if i < len(stamps) else 0,
+                sync, segment_bytes)
+
+        if self.n_shards > 1 and (os.cpu_count() or 1) > 1:
+            with concurrent.futures.ThreadPoolExecutor(
+                    max_workers=min(8, self.n_shards),
+                    thread_name_prefix="theia-wal-replay") as pool:
+                per_shard = list(pool.map(_attach,
+                                          range(self.n_shards)))
+        else:
+            per_shard = [_attach(i) for i in range(self.n_shards)]
+        from .wal import adopt_foreign_wal_dirs
+        adopted = adopt_foreign_wal_dirs(self, wal_dir, dirs, stamps)
+        stats: Dict[str, object] = {
+            "recoveredRows": sum(int(s["recoveredRows"])
+                                 for s in per_shard),
+            "recoveredRecords": sum(int(s["recoveredRecords"])
+                                    for s in per_shard),
+            "droppedRecords": sum(int(s["droppedRecords"])
+                                  for s in per_shard),
+            "droppedBytes": sum(int(s["droppedBytes"])
+                                for s in per_shard),
+            "tornTail": any(s["tornTail"] for s in per_shard),
+            "gapped": any(s["gapped"] for s in per_shard),
+            "lastLsn": [int(s["lastLsn"]) for s in per_shard],
+            "perShard": per_shard,
+        }
+        if adopted:
+            stats["adoptedRows"] = adopted
+        return stats
+
+    @contextlib.contextmanager
+    def wal_suspended(self):
+        with contextlib.ExitStack() as stack:
+            for s in self.shards:
+                stack.enter_context(s.wal_suspended())
+            yield
+
+    def wal_stats(self) -> Optional[Dict[str, object]]:
+        per = [s.wal_stats() for s in self.shards]
+        if not any(per):
+            return None
+        live = [p for p in per if p]
+        return {
+            "shards": len(per),
+            "segments": sum(p["segments"] for p in live),
+            "bytes": sum(p["bytes"] for p in live),
+            "lagRecords": sum(p["lagRecords"] for p in live),
+            "lagBytes": sum(p["lagBytes"] for p in live),
+            "lastLsn": [p["lastLsn"] if p else None for p in per],
+            "syncedLsn": [p["syncedLsn"] if p else None for p in per],
+            "policy": live[0]["policy"],
+        }
+
+    def wal_position(self) -> Optional[List[int]]:
+        pos = [s.wal_position() for s in self.shards]
+        if all(p is None for p in pos):
+            return None
+        return [0 if p is None else p for p in pos]
+
+    def wal_reposition(self, position) -> None:
+        if position is None:
+            return
+        if not isinstance(position, (list, tuple)):
+            position = [position] * self.n_shards
+        for s, p in zip(self.shards, position):
+            s.wal_reposition(p)
+
+    def wal_sync(self) -> None:
+        for s in self.shards:
+            s.wal_sync()
+
+    def wal_gc(self, stamp) -> int:
+        if stamp is None:
+            return 0
+        if not isinstance(stamp, (list, tuple)):
+            stamp = [stamp] * self.n_shards
+        return sum(s.wal_gc(p) for s, p in zip(self.shards, stamp))
+
+    def close_wal(self) -> None:
+        for s in self.shards:
+            s.close_wal()
+
     # -- retention --------------------------------------------------------
 
     def evict_ttl(self, now: int) -> int:
@@ -287,19 +393,38 @@ class ShardedFlowDatabase:
     # -- persistence ------------------------------------------------------
 
     def save(self, path: str, tables=None, compress: bool = True
-             ) -> None:
+             ) -> Optional[List[int]]:
         """Persist the *logical* contents as one single-node snapshot
         (FlowDatabase format); loading re-shards. Mirrors backing up a
-        cluster through the Distributed table."""
+        cluster through the Distributed table.
+
+        With WALs attached, a full snapshot quiesces EVERY shard's log
+        while it stamps the per-shard LSN vector and scans, so each
+        stamp exactly partitions that shard's records into in-snapshot
+        vs to-replay; returns the vector for wal_gc()."""
+        wals = [s._wal for s in self.shards]
+        stamps: Optional[List[int]] = None
+        with contextlib.ExitStack() as stack:
+            if tables is None and any(w is not None for w in wals):
+                for w in wals:
+                    if w is not None:
+                        stack.enter_context(w.quiesce())
+                stamps = [0 if w is None else w.last_lsn
+                          for w in wals]
+            datas = {"flows": self.flows.scan()}
+            for name, src in self.result_tables.items():
+                datas[name] = src.scan()
+        # merge + serialize OUTSIDE the quiesce window — only the
+        # scans need the consistent point
         merged = FlowDatabase()
-        flows = self.flows.scan()
-        if len(flows):
-            merged.flows.insert(flows)
-        for name, src in self.result_tables.items():
-            data = src.scan()
-            if len(data):
-                merged.result_tables[name].insert(data)
-        merged.save(path, tables=tables, compress=compress)
+        if len(datas["flows"]):
+            merged.flows.insert(datas["flows"])
+        for name in self.result_tables:
+            if len(datas[name]):
+                merged.result_tables[name].insert(datas[name])
+        write_snapshot(path, merged._snapshot_payload(tables),
+                       compress=compress, wal_lsns=stamps)
+        return stamps
 
     @classmethod
     def load(cls, path: str, n_shards: int = 2,
@@ -311,6 +436,7 @@ class ShardedFlowDatabase:
         # itself evicts persisted rows, at a routing-dependent boundary
         # per shard.
         db = cls(n_shards=n_shards, ttl_seconds=None, seed=seed)
+        db._snapshot_lsns = list(single._snapshot_lsns)
         flows = single.flows.scan()
         if len(flows):
             db.insert_flows(flows)

@@ -29,6 +29,13 @@ Instrumented sites:
     replica.write     ReplicatedFlowDatabase per-replica fan-out write
                       (ctx: replica index, op)
     checkpoint.save   Checkpointer.checkpoint, before the snapshot
+    wal.append        WriteAheadLog.append, before any bytes are
+                      written (an injected error fails the insert —
+                      no acknowledgement without durability)
+    wal.fsync         WriteAheadLog.sync, before flush+fsync (the
+                      sync-policy durability point)
+    wal.rotate        WAL segment rotation, before the old segment is
+                      sealed
     runner.spawn      JobController subprocess dispatch, before Popen
     runner.exec       job execution: thread dispatch fires in-process;
                       the runner child fires after argv parse (exits
