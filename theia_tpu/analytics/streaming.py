@@ -139,10 +139,14 @@ class StreamingDetector:
 
     def __init__(self, capacity: int = 65536,
                  alpha: float = DEFAULT_ALPHA,
-                 value_column: str = "throughput") -> None:
+                 value_column: str = "throughput",
+                 clock=time.perf_counter) -> None:
         self.capacity = capacity
         self.alpha = alpha
         self.value_column = value_column
+        #: injectable for deterministic latency_s in tests (the alert
+        #: latency is a measurement, not detector state)
+        self.clock = clock
         self.state = init_state(capacity)
         # packed key bytes → slot; dropped keys are remembered with
         # slot -1 so a series is only counted dropped once, however
@@ -181,7 +185,7 @@ class StreamingDetector:
         """
         if len(batch) == 0:
             return []
-        t_arrival = time.perf_counter()
+        t_arrival = self.clock()
         keys = np.ascontiguousarray(np.stack(
             [np.asarray(batch[c], np.int64)
              for c in CONNECTION_KEY_COLUMNS], axis=1))
@@ -241,7 +245,7 @@ class StreamingDetector:
         hits = np.argwhere(np.asarray(anomaly))
         if not hits.size:
             return []
-        latency = time.perf_counter() - t_arrival
+        latency = self.clock() - t_arrival
         alerts: List[Dict[str, object]] = []
         for t, c in hits:
             i = int(row_idx[t, c])

@@ -65,6 +65,15 @@ class APIConnectionError(APIError):
     everywhere a human is waiting on one answer."""
 
 
+class APIRetryAfterError(APIConnectionError):
+    """HTTP 429: the manager is over CAPACITY (not down) and said when
+    to come back. `retry_after` carries the server's hint; poll loops
+    treat it like any transient failure, the ingest client honors the
+    hint precisely."""
+
+    retry_after = 1.0
+
+
 _CA_CERT = ""
 _TOKEN = ""
 
@@ -91,11 +100,18 @@ def _urlopen(addr: str, req: urllib.request.Request,
                                     context=_url_context()) as resp:
             return resp.read()
     except urllib.error.HTTPError as e:
-        detail = e.read().decode(errors="replace")
+        body = e.read().decode(errors="replace")
+        detail = body
         try:
-            detail = json.loads(detail).get("message", detail)
+            detail = json.loads(body).get("message", body)
         except Exception:
             pass
+        if e.code == 429:
+            from ..ingest.client import parse_retry_after
+            err = APIRetryAfterError(
+                f"error: manager over capacity (429): {detail}")
+            err.retry_after = parse_retry_after(e.headers, body)
+            raise err
         cls = APIConnectionError if e.code == 503 else APIError
         raise cls(f"error: {e.code} from manager: {detail}")
     except urllib.error.URLError as e:
@@ -601,6 +617,46 @@ def profile(args) -> None:
           f"view with TensorBoard/xprof")
 
 
+# -- ingest (exactly-once producer; the Flow-Aggregator-over-the-wire
+# -- role, driven from a shell) -----------------------------------------
+
+def ingest_cmd(args) -> None:
+    """Produce synthetic flow batches to POST /ingest through the
+    exactly-once client (stream+seq stamping, Retry-After honored
+    with jittered capped backoff) — the operator's load/drill tool
+    and the smallest correct producer to crib from."""
+    from ..data.synth import SynthConfig, generate_flows
+    from ..ingest import BlockEncoder
+    from ..ingest.client import IngestClient, IngestError
+
+    enc = BlockEncoder()
+    batch = generate_flows(SynthConfig(
+        n_series=args.series, points_per_series=args.points,
+        anomaly_fraction=args.anomaly_fraction, seed=args.seed),
+        dicts=enc.dicts)
+    client = IngestClient(args.manager_addr,
+                          stream=args.stream or None,
+                          token=_TOKEN, ca_cert=_CA_CERT or None)
+    alerts = 0
+    t0 = time.time()
+    try:
+        for i in range(args.batches):
+            out = client.send(enc.encode(batch))
+            alerts += int(out.get("alerts", 0))
+            if args.interval > 0 and i + 1 < args.batches:
+                time.sleep(args.interval)
+    except IngestError as e:
+        print(f"error: {e}", file=sys.stderr)
+        raise SystemExit(1)
+    dt = max(time.time() - t0, 1e-9)
+    s = client.summary()
+    print(f"stream {s['stream']}: acked {s['rowsAcked']} rows in "
+          f"{s['batchesAcked']} batches ({s['rowsAcked'] / dt:,.0f} "
+          f"rows/s), {alerts} alerts, {s['duplicates']} duplicate "
+          f"acks, {s['rejected429']} over-capacity retries, "
+          f"{s['transientRetries']} transient retries")
+
+
 # -- top (live rates from GET /metrics; no reference equivalent — the
 # -- closest is watching the provisioned Grafana dashboards) ------------
 
@@ -667,6 +723,16 @@ def top(args) -> None:
                 TIME_FORMAT)
             print(f"theia top — {args.manager_addr}  {stamp}  "
                   f"({len(rows)} series)")
+            lvl = sample.get(("theia_admission_level", ()))
+            if lvl is not None:
+                # rung names mirror manager/admission.py LEVEL_NAMES
+                # (kept literal here so `theia top` stays import-light)
+                names = ("ok", "sampled", "shed_detector", "reject")
+                i_lvl = min(max(int(lvl), 0), len(names) - 1)
+                pressure = sample.get(("theia_admission_pressure",
+                                       ()), 0.0)
+                print(f"admission: {names[i_lvl]} (rung {i_lvl}, "
+                      f"pressure {pressure:.2f})")
             if rows:
                 _print_table(rows, ["METRIC", "LABELS", "RATE/s",
                                     "VALUE"])
@@ -882,6 +948,24 @@ def build_parser() -> argparse.ArgumentParser:
                         help="accelerator inventory + HBM usage "
                              "(no reference equivalent)")
     status.set_defaults(fn=clickhouse_status)
+
+    ing = sub.add_parser("ingest",
+                         help="produce synthetic flow batches to "
+                              "POST /ingest (exactly-once: stream+seq "
+                              "stamped, 429 Retry-After honored)")
+    ing.add_argument("--stream", default="",
+                     help="producer stream id (default: random)")
+    ing.add_argument("--batches", type=int, default=10)
+    ing.add_argument("--series", type=int, default=64,
+                     help="synthetic connection series per batch")
+    ing.add_argument("--points", type=int, default=30,
+                     help="points per series per batch")
+    ing.add_argument("--anomaly-fraction", dest="anomaly_fraction",
+                     type=float, default=0.1)
+    ing.add_argument("--interval", type=float, default=0.0,
+                     help="seconds between batches (0 = flat out)")
+    ing.add_argument("--seed", type=int, default=0)
+    ing.set_defaults(fn=ingest_cmd)
 
     sb = sub.add_parser("supportbundle")
     sb.add_argument("-f", "--file", default="")

@@ -1,5 +1,7 @@
-"""Ingest paths: wire bytes -> columnar blocks (native C++ + fallback)."""
+"""Ingest paths: wire bytes -> columnar blocks (native C++ + fallback),
+plus the exactly-once producer client (client.py)."""
 
+from .client import IngestClient, IngestError
 from .native import (
     BLOCK_MAGIC,
     BlockEncoder,
@@ -9,4 +11,4 @@ from .native import (
 )
 
 __all__ = ["BLOCK_MAGIC", "BlockEncoder", "TsvDecoder", "encode_tsv",
-           "native_available"]
+           "native_available", "IngestClient", "IngestError"]

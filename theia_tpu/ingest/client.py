@@ -1,0 +1,193 @@
+"""Producer-side ingest client: exactly-once retried POST /ingest.
+
+The manager's overload-control plane (manager/admission.py) answers
+over-capacity requests with **429 + Retry-After** and transient
+unavailability with **503**; a producer that times out or gets shed
+must RETRY THE SAME BATCH — and the retry must not double-insert if
+the first attempt actually landed (ack lost on the wire, manager
+killed after the WAL append). This client implements that contract so
+every producer (the `theia ingest` CLI, bench.py's overload legs,
+operator scripts) gets it right once:
+
+  * every batch is stamped `?stream=<id>&seq=<n>` — the manager's
+    per-stream dedup window makes a retry idempotent, including
+    across a manager kill -9 + WAL recovery;
+  * 429 sleeps `Retry-After` (the precise `retryAfterSeconds` from
+    the JSON body when present) plus jittered capped backoff, so a
+    rejected fleet does not return in lockstep;
+  * 503 / connection errors sleep jittered capped backoff alone;
+  * any other HTTP error (400 malformed payload, 401/403 auth) is
+    permanent and raised immediately — retrying a payload the manager
+    called malformed would reset the stream forever.
+
+TFB2 discipline note: blocks from one BlockEncoder carry dictionary
+DELTAS, so a rejected block must be retried (not skipped) before the
+next block is sent — exactly what `send()` does. Duplicate acks do
+not decode on the manager, so a retry after a lost ack leaves the
+stream's delta chain consistent.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import random
+import ssl
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+import uuid
+from typing import Callable, Dict, Optional
+
+from ..utils.backoff import jittered_backoff
+from ..utils.logging import get_logger
+
+logger = get_logger("ingest-client")
+
+
+class IngestError(Exception):
+    """Permanent ingest failure (malformed payload, auth, or retry
+    budget exhausted)."""
+
+
+def parse_retry_after(headers, body: str) -> float:
+    """The one place the 429 retry-hint fallback chain lives (shared
+    with the CLI's error taxonomy): the precise `retryAfterSeconds`
+    float from the JSON body when present, else the integer
+    Retry-After header, else 1s."""
+    try:
+        ra = json.loads(body).get("retryAfterSeconds")
+        if ra is not None:
+            return max(0.0, float(ra))
+    except Exception:
+        pass
+    try:
+        return max(0.0, float(headers.get("Retry-After", "1")))
+    except (TypeError, ValueError):
+        return 1.0
+
+
+class IngestClient:
+    """One producer stream against a manager's POST /ingest."""
+
+    def __init__(self, addr: str, stream: Optional[str] = None,
+                 token: str = "", ca_cert: Optional[str] = None,
+                 timeout: float = 30.0, max_attempts: int = 12,
+                 backoff_base: float = 0.2, backoff_cap: float = 10.0,
+                 rng: Optional[random.Random] = None,
+                 sleep: Callable[[float], None] = time.sleep) -> None:
+        self.addr = addr.rstrip("/")
+        self.stream = stream or f"p-{uuid.uuid4().hex[:12]}"
+        self.token = token
+        self.timeout = timeout
+        self.max_attempts = int(max_attempts)
+        self.backoff_base = backoff_base
+        self.backoff_cap = backoff_cap
+        self._rng = rng if rng is not None else random.Random()
+        self._sleep = sleep
+        self._ctx = (ssl.create_default_context(cafile=ca_cert)
+                     if ca_cert else None)
+        self.seq = 0
+        # producer-side ledger (the bench/CLI summary surface)
+        self.rows_acked = 0
+        self.batches_acked = 0
+        self.duplicates = 0
+        self.rejected = 0     # 429 responses absorbed
+        self.retries = 0      # 503/connection retries absorbed
+
+    def _headers(self) -> Dict[str, str]:
+        h = {"Content-Type": "application/octet-stream"}
+        if self.token:
+            h["Authorization"] = f"Bearer {self.token}"
+        return h
+
+    def send(self, payload: bytes,
+             seq: Optional[int] = None) -> Dict[str, object]:
+        """POST one batch, retrying until acknowledged (or the attempt
+        budget runs out). Returns the manager's ack; `duplicate: true`
+        means a previous attempt already landed — the ledger counts it
+        once either way."""
+        if seq is None:
+            self.seq += 1
+            seq = self.seq
+        else:
+            self.seq = max(self.seq, int(seq))
+        url = (f"{self.addr}/ingest?"
+               f"stream={urllib.parse.quote(self.stream)}&seq={seq}")
+        last: Optional[str] = None
+        for attempt in range(1, self.max_attempts + 1):
+            try:
+                req = urllib.request.Request(
+                    url, method="POST", data=payload,
+                    headers=self._headers())
+                with urllib.request.urlopen(
+                        req, timeout=self.timeout,
+                        context=self._ctx) as resp:
+                    out = json.loads(resp.read())
+                if out.get("duplicate"):
+                    self.duplicates += 1
+                else:
+                    self.rows_acked += int(out.get("rows", 0))
+                self.batches_acked += 1
+                return out
+            except urllib.error.HTTPError as e:
+                body = e.read().decode(errors="replace")
+                if e.code == 429:
+                    self.rejected += 1
+                    delay = (parse_retry_after(e.headers, body)
+                             + jittered_backoff(self.backoff_base,
+                                                self.backoff_cap,
+                                                attempt, self._rng))
+                    last = f"429: {body[:200]}"
+                elif e.code >= 500:
+                    # 503 unavailable AND 500: the server records the
+                    # ack whenever the insert leg succeeded even if
+                    # the request then 500'd (detector exception) —
+                    # retrying the same seq either lands the batch or
+                    # collects the duplicate ack; aborting would lose
+                    # it. Only 4xx (malformed payload, auth) is
+                    # permanent.
+                    self.retries += 1
+                    delay = jittered_backoff(self.backoff_base,
+                                             self.backoff_cap,
+                                             attempt, self._rng)
+                    last = f"{e.code}: {body[:200]}"
+                else:
+                    raise IngestError(
+                        f"batch seq={seq} permanently rejected "
+                        f"({e.code}): {body[:500]}")
+            except (OSError, http.client.HTTPException) as e:
+                # Transport failure at ANY phase: URLError (connect),
+                # raw socket.timeout/TimeoutError (urllib does NOT
+                # wrap read-phase timeouts), RemoteDisconnected /
+                # BadStatusLine (mid-response hangup) — all OSError or
+                # HTTPException. The retry-with-same-seq discipline
+                # makes "timed out but landed" safe: the manager
+                # answers the retry duplicate:true.
+                self.retries += 1
+                delay = jittered_backoff(self.backoff_base,
+                                         self.backoff_cap, attempt,
+                                         self._rng)
+                last = (f"unreachable: "
+                        f"{getattr(e, 'reason', None) or e!r}")
+            if attempt >= self.max_attempts:
+                break   # budget spent — don't sleep just to raise
+            logger.v(1).info(
+                "ingest stream=%s seq=%d attempt %d/%d: %s; retrying "
+                "in %.2fs", self.stream, seq, attempt,
+                self.max_attempts, last, delay)
+            self._sleep(delay)
+        raise IngestError(
+            f"batch seq={seq} not acknowledged after "
+            f"{self.max_attempts} attempts (last: {last})")
+
+    def summary(self) -> Dict[str, object]:
+        return {
+            "stream": self.stream,
+            "batchesAcked": self.batches_acked,
+            "rowsAcked": self.rows_acked,
+            "duplicates": self.duplicates,
+            "rejected429": self.rejected,
+            "transientRetries": self.retries,
+        }

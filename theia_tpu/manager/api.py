@@ -236,6 +236,24 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
         self._send_json({"kind": "Status", "status": "Failure",
                          "message": message, "code": code}, code)
 
+    def _send_retry_after(self, e) -> None:
+        """429 Too Many Requests + Retry-After (integer header per
+        RFC 9110; the JSON body carries the precise float for clients
+        that can use it)."""
+        import math
+        raw = json.dumps({
+            "kind": "Status", "status": "Failure", "message": str(e),
+            "reason": e.reason, "code": 429,
+            "retryAfterSeconds": round(e.retry_after, 3),
+        }).encode()
+        self.send_response(429)
+        self.send_header("Retry-After",
+                         str(max(1, math.ceil(e.retry_after))))
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(raw)))
+        self.end_headers()
+        self.wfile.write(raw)
+
     def _require_auth(self) -> None:
         """Enforce the static bearer token (no-op when auth is off).
         Constant-time comparison; 401 for absent/malformed
@@ -314,6 +332,7 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
             self._send_error_json(500, f"{type(e).__name__}: {e}")
 
     def do_POST(self) -> None:  # noqa: N802
+        from .admission import AdmissionRejected
         from .ingest import StreamCapacityError
         try:
             self._require_auth()   # every POST mutates state
@@ -322,6 +341,10 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
             self._send_auth_error(e)
         except DuplicateJobError as e:
             self._send_error_json(409, str(e))
+        except AdmissionRejected as e:
+            # over CAPACITY (retry later, we are fine) — deliberately
+            # distinct from 503 (the store itself is unavailable)
+            self._send_retry_after(e)
         except (StreamCapacityError, AllReplicasDownError) as e:
             # retryable capacity/availability condition, not a client
             # payload error
@@ -433,6 +456,16 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
                 "theia_detector_series",
                 "Tracked connection series across detector shards"
             ).set(sum(s["series"] for s in live["perShard"]))
+            _obs_metrics.gauge(
+                "theia_ingest_insert_inflight",
+                "Store-insert legs submitted but not finished (the "
+                "bounded insert backlog)").set(
+                    self.ingest.inflight_count())
+            adm = getattr(self.ingest, "admission", None)
+            if adm is not None:
+                # refresh theia_admission_level/_pressure at scrape
+                # time (and let an idle manager step the ladder down)
+                adm.evaluate()
         if isinstance(db, ReplicatedFlowDatabase):
             m = db.membership()
             _obs_metrics.gauge(
@@ -464,6 +497,19 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
         }
         if self.ingest is not None:
             doc["ingest"] = self.ingest.shard_liveness()
+            adm = getattr(self.ingest, "admission", None)
+            if adm is not None:
+                # current brownout rung + the pressure signals that
+                # put it there (refreshed here so a scrape-only
+                # manager still de-escalates); above rung 0 the
+                # manager is serving but degraded
+                adm.evaluate()
+                doc["admission"] = adm.snapshot()
+                if adm.level() > 0 and doc["status"] == "ok":
+                    doc["status"] = "degraded"
+            dedup = getattr(self.ingest, "dedup", None)
+            if dedup is not None:
+                doc["dedup"] = dedup.stats()
         db = self.controller.db
         if isinstance(db, ReplicatedFlowDatabase):
             m = db.membership()
@@ -623,11 +669,18 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
     def _post(self) -> None:
         parts = self._route()
         if parts == ("ingest",):
-            stream = self._query().get("stream", "default")
+            q = self._query()
+            stream = q.get("stream", "default")
+            seq_raw = q.get("seq")
+            try:
+                seq = int(seq_raw) if seq_raw is not None else None
+            except ValueError:
+                raise ValueError(f"seq={seq_raw!r} is not an integer")
             payload = self._read_raw_body()
             if not payload:
                 raise ValueError("empty ingest payload")
-            self._send_json(self.ingest.ingest(payload, stream=stream))
+            self._send_json(self.ingest.ingest(payload, stream=stream,
+                                               seq=seq))
             return
         if self.path.startswith(GROUP_INTELLIGENCE) and len(parts) == 4:
             kind = _RESOURCE_KIND[parts[3]]
@@ -730,6 +783,14 @@ class TheiaManagerServer:
         self.controller = JobController(
             db, workers=workers, dispatch=dispatch,
             alert_sink=self.ingest.push_alert)
+        if self.ingest.admission is not None:
+            # third pressure signal (the ingest manager wired the
+            # insert backlog + WAL lag itself): a deep job queue means
+            # the workers are saturated — stop piling ingest on top
+            from ..utils.env import env_int as _env_int
+            self.ingest.admission.add_signal(
+                "jobQueue", self.controller._queue.qsize,
+                _env_int("THEIA_JOB_QUEUE_HIGH", 64))
         self.stats = StatsProvider(db, capacity_bytes=capacity_bytes)
         self.bundles = SupportBundleManager(self.controller, self.stats,
                                             ingest=self.ingest)

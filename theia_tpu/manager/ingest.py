@@ -39,6 +39,15 @@ Concurrency shape (the shard-parallel, pipelined path):
   * The ingest-global dictionary remap has its own fine-grained lock;
     minting a new global code never stalls another shard's scoring.
 
+Overload control (manager/admission.py): every `/ingest` request
+passes the admission plane first — token buckets (THEIA_INGEST_RATE /
+THEIA_INGEST_BURST), pressure watermarks over the insert backlog, WAL
+sync lag, and job queue, and a brownout ladder that sheds the scoring
+leg before rejecting (429 + Retry-After; durability is never shed).
+Producers that stamp batches with `?seq=<n>` get exactly-once retried
+ingest through a bounded per-stream dedup window that survives crash
+recovery via the WAL record tags.
+
 Ordering guarantee: alerts are deterministic PER CONNECTION. A
 destination always hashes to the same shard (a stable string hash,
 not a dictionary code — so the assignment survives restarts), the
@@ -72,6 +81,13 @@ from ..obs import trace as _trace
 from ..schema import ColumnarBatch, DictionaryMapper, StringDictionary
 from ..utils import get_logger
 from ..utils.env import env_int
+from . import admission as _admission
+from .admission import (
+    LEVEL_NAMES,
+    LEVEL_OK,
+    AdmissionController,
+    DedupWindow,
+)
 
 logger = get_logger("ingest")
 
@@ -113,6 +129,11 @@ _M_LOCK_WAIT = _metrics.counter(
     "theia_ingest_shard_lock_waits_total",
     "Forced blocking shard-lock acquisitions (every remaining shard "
     "was busy — the convoy case)")
+_M_SHED_ROWS = _metrics.counter(
+    "theia_ingest_shed_rows_total",
+    "Rows whose detector/scoring leg was shed by the brownout ladder "
+    "(the rows themselves were stored and acknowledged)",
+    labelnames=("mode",))
 
 MAX_ALERTS = 1000
 
@@ -189,7 +210,9 @@ class IngestManager:
 
     def __init__(self, db, detector: Optional[HeavyHitterDetector] = None,
                  streaming: Optional[StreamingDetector] = None,
-                 n_shards: Optional[int] = None) -> None:
+                 n_shards: Optional[int] = None,
+                 admission: Optional[AdmissionController] = None
+                 ) -> None:
         self.db = db
         self._streams: Dict[str, _Stream] = {}
         self._registry_lock = threading.Lock()
@@ -246,9 +269,10 @@ class IngestManager:
         # parallelism with headroom, capped at the stream slot count —
         # NOT to the detector shard count, which is unrelated to
         # insert parallelism.
+        self._insert_workers = min(MAX_STREAMS,
+                                   max(4, 2 * (os.cpu_count() or 1)))
         self._insert_pool = ThreadPoolExecutor(
-            max_workers=min(MAX_STREAMS,
-                            max(4, 2 * (os.cpu_count() or 1))),
+            max_workers=self._insert_workers,
             thread_name_prefix="theia-ingest-insert")
         # In-flight store-insert legs, tracked so close() can drain
         # them with a BOUND (ThreadPoolExecutor.shutdown(wait=True)
@@ -256,6 +280,64 @@ class IngestManager:
         # forever past the WAL-fsync/final-checkpoint steps).
         self._inflight_lock = threading.Lock()
         self._inflight: set = set()
+        # -- overload-control plane (manager/admission.py) -----------
+        # Explicit backlog bound: the insert pool's queue used to grow
+        # without limit during a store stall; crossing the high
+        # watermark now drives the admission ladder to reject instead.
+        self.inflight_high = env_int("THEIA_INGEST_INFLIGHT_HIGH",
+                                     0) or 2 * self._insert_workers
+        if os.environ.get("THEIA_ADMISSION_DISABLED", "") == "1":
+            self.admission: Optional[AdmissionController] = None
+        else:
+            self.admission = (admission if admission is not None
+                              else AdmissionController())
+        if self.admission is not None:
+            self.admission.add_signal("insertBacklog",
+                                      self.inflight_count,
+                                      self.inflight_high)
+            self.admission.add_signal(
+                "walLag", self._wal_lag,
+                env_int("THEIA_WAL_LAG_HIGH", 50_000))
+        # Exactly-once retried ingest: (stream, seq)-stamped batches
+        # dedup against this window; recovery re-seeds it from the
+        # tags the WAL replay surfaced, so the idempotency contract
+        # survives kill -9.
+        self.dedup = DedupWindow()
+        # (stream, seq) batches currently IN FLIGHT: a retry racing
+        # its still-processing original (client timeout shorter than a
+        # stalled insert — the overload case) must not decode+insert a
+        # second copy, and must not re-apply the block's dictionary
+        # delta; it is answered 429 and finds duplicate:true once the
+        # original acks.
+        self._pending_lock = threading.Lock()
+        self._pending: set = set()
+        recovered = getattr(db, "recovered_acks", None)
+        if callable(recovered):
+            n_seeded = 0
+            for ack_stream, ack_seq, ack_rows, ack_total \
+                    in recovered():
+                if ack_total is not None and ack_rows < ack_total:
+                    # A sharded batch's slices fsync independently
+                    # under interval sync: part of this acked batch
+                    # was not durable at the crash. Seeding anyway is
+                    # the lesser evil — NOT seeding would make the
+                    # producer's retry duplicate every recovered row —
+                    # but the shortfall must be loud, and it is
+                    # bounded by the WAL sync policy's documented loss
+                    # window (THEIA_WAL_SYNC=always closes it).
+                    logger.error(
+                        "recovered ack (stream=%r seq=%d) is PARTIAL:"
+                        " %d of %d rows were durable at the crash; "
+                        "the missing rows are within the WAL sync-"
+                        "policy loss bound and a retry will be "
+                        "answered duplicate:true", ack_stream,
+                        ack_seq, ack_rows, ack_total)
+                self.dedup.record(ack_stream, ack_seq, ack_rows)
+                n_seeded += 1
+            if n_seeded:
+                logger.info(
+                    "dedup window seeded with %d acknowledged "
+                    "batches recovered from the WAL", n_seeded)
 
     def _submit_insert(self, fn, *args):
         fut = self._insert_pool.submit(fn, *args)
@@ -267,6 +349,19 @@ class IngestManager:
     def _discard_inflight(self, fut) -> None:
         with self._inflight_lock:
             self._inflight.discard(fut)
+
+    def inflight_count(self) -> int:
+        """Store-insert legs submitted but not finished — the insert
+        backlog the admission plane watches against `inflight_high`."""
+        with self._inflight_lock:
+            return len(self._inflight)
+
+    def _wal_lag(self) -> int:
+        fn = getattr(self.db, "wal_lag", None)
+        try:
+            return int(fn()) if callable(fn) else 0
+        except Exception:
+            return 0
 
     def close(self, drain: bool = True,
               drain_timeout: float = 60.0) -> None:
@@ -324,12 +419,78 @@ class IngestManager:
             if self._streams.get(stream_id) is st:
                 del self._streams[stream_id]
 
-    def ingest(self, payload: bytes,
-               stream: str = "default") -> Dict[str, object]:
+    def ingest(self, payload: bytes, stream: str = "default",
+               seq: Optional[int] = None) -> Dict[str, object]:
         """Decode one wire payload, insert ∥ score. Raises ValueError on
         malformed payloads (mapped to HTTP 400 by the API layer); the
-        failing stream is reset and must restart its encoder."""
+        failing stream is reset and must restart its encoder.
+
+        `seq` is the producer's monotone batch sequence number within
+        its stream: a retry of an already-acknowledged (stream, seq) —
+        after a timeout, a 429, or a crash+recovery — is answered
+        `{"duplicate": true}` with the original row count, without
+        touching decoder, store, or detector state. The duplicate
+        check runs BEFORE admission: answering a retry is how the
+        producer learns its batch landed, so it must work even while
+        new work is being rejected. Raises AdmissionRejected (HTTP 429
+        + Retry-After) when the overload-control plane refuses the
+        batch; under the brownout ladder's degraded rungs the
+        detector/scoring leg is sampled or shed while rows stay
+        durable (WAL + store) and acknowledged."""
         t_req = time.perf_counter()
+        if seq is not None:
+            seq = int(seq)
+            dup_rows = self.dedup.lookup(stream, seq)
+            if dup_rows is None:
+                with self._pending_lock:
+                    if (stream, seq) in self._pending:
+                        # the original attempt is still running: a
+                        # second decode would double-insert AND
+                        # corrupt the stream's dictionary-delta chain
+                        # — tell the producer to come back for its
+                        # duplicate ack
+                        if self.admission is not None:
+                            # keep /healthz admission.rejected in
+                            # lockstep with the metric
+                            self.admission.note_rejected()
+                        _admission._M_REJECTED.labels(
+                            reason="in_flight").inc()
+                        raise _admission.AdmissionRejected(
+                            "in_flight", 0.25,
+                            f"(stream={stream!r}, seq={seq}) is "
+                            f"still being processed")
+                    # Re-check under the lock: the original may have
+                    # COMPLETED between the lock-free lookup above and
+                    # here (it records its ack strictly before it
+                    # drops its reservation, so a second miss now is
+                    # authoritative — no completed-and-acked original
+                    # exists).
+                    dup_rows = self.dedup.lookup(stream, seq)
+                    if dup_rows is None:
+                        self._pending.add((stream, seq))
+            if dup_rows is not None:
+                _admission._M_DEDUP_HITS.inc()
+                _admission._M_DUP_ROWS.inc(dup_rows)
+                logger.v(1).info(
+                    "duplicate batch (stream=%r seq=%d, %d rows) "
+                    "acked idempotently", stream, seq, dup_rows)
+                return {"rows": dup_rows, "alerts": 0,
+                        "duplicate": True}
+        try:
+            return self._ingest_admitted(payload, stream, seq, t_req)
+        finally:
+            if seq is not None:
+                with self._pending_lock:
+                    self._pending.discard((stream, seq))
+
+    def _ingest_admitted(self, payload: bytes, stream: str,
+                         seq: Optional[int],
+                         t_req: float) -> Dict[str, object]:
+        level = LEVEL_OK
+        if self.admission is not None:
+            # raises AdmissionRejected → 429 + Retry-After (payload
+            # bytes are charged here; rows after decode)
+            level = self.admission.admit(stream, len(payload))
         st = self._stream(stream)
         # The stream lock guards only the DECODE (the dictionary-delta
         # chain is per-stream state); the store insert runs outside it,
@@ -360,6 +521,10 @@ class IngestManager:
                 _M_ERRORS.labels(stage="decode").inc()
                 raise
             _M_STAGE_DECODE.observe(time.perf_counter() - t_dec)
+        if self.admission is not None:
+            # post-decode row accounting: the row bucket may go into
+            # debt, which rejects FUTURE requests until it refills
+            self.admission.charge_rows(stream, len(batch))
         # Pipelined legs: the store insert (MV fan-out, TTL) and the
         # detector scoring are independent consumers of the decoded
         # batch (both read-only), so they run overlapped and the
@@ -368,27 +533,56 @@ class IngestManager:
         # sketch state (that can't be rolled back), so a producer
         # retrying the 5xx'd payload counts those rows twice in the
         # detectors — at-least-once detector semantics, where the
-        # pre-pipelined path skipped scoring on insert failure. The
+        # pre-pipelined path skipped scoring on insert failure (a
+        # seq-stamped producer avoids the double count entirely: the
+        # retry of an acked batch never reaches the detectors). The
         # batch's alerts are still withheld (published only after the
         # insert leg succeeds, below), and the store itself stays
         # exactly-once.
-        fut = self._submit_insert(self._timed_insert, batch)
-        try:
-            t_det = time.perf_counter()
-            alerts, conn_alerts, n_conn = self.score_batch(batch)
-            _M_STAGE_DET.observe(time.perf_counter() - t_det)
-        except Exception:
-            _M_ERRORS.labels(stage="detector").inc()
-            raise
-        finally:
-            # Always await the insert leg, even when scoring raised:
-            # an unawaited future would hide the store's exception and
-            # break the acked-rows conservation contract.
-            insert_exc = fut.exception()
+        # the tag carries the LOGICAL batch size so a sharded store's
+        # per-slice WAL records can reconstruct (and sanity-check) the
+        # whole ack at recovery
+        dedup_tag = ((stream, seq, len(batch))
+                     if seq is not None else None)
+        fut = self._submit_insert(self._timed_insert, batch, dedup_tag)
+        # Brownout: under pressure the scoring leg degrades first —
+        # sampled at a declining fraction, then fully shed — while the
+        # durable leg (WAL + store) keeps acknowledging rows.
+        scored = (level == LEVEL_OK
+                  or (self.admission is not None
+                      and self.admission.should_score(level)))
+        if scored:
+            try:
+                t_det = time.perf_counter()
+                alerts, conn_alerts, n_conn = self.score_batch(batch)
+                _M_STAGE_DET.observe(time.perf_counter() - t_det)
+            except Exception:
+                _M_ERRORS.labels(stage="detector").inc()
+                # await the insert leg even when scoring raised: an
+                # unawaited future would hide the store's exception
+                # and break acked-rows conservation. If the insert
+                # SUCCEEDED, the rows (and their WAL tag) are durable
+                # even though this request will 500 — record the ack
+                # NOW so the producer's retry is answered
+                # duplicate:true instead of double-inserting (and
+                # desyncing its delta chain), exactly as a
+                # crash+replay of the same record would behave.
+                if fut.exception() is None and seq is not None:
+                    self.dedup.record(stream, seq, fut.result())
+                raise
+        else:
+            alerts, conn_alerts, n_conn = [], [], 0
+            _M_SHED_ROWS.labels(mode=LEVEL_NAMES[level]).inc(
+                len(batch))
+        insert_exc = fut.exception()
         if insert_exc is not None:
             _M_ERRORS.labels(stage="store_insert").inc()
             raise insert_exc
         n = fut.result()
+        if seq is not None:
+            # the ack is now durable to the WAL's policy bound; a
+            # retry of this (stream, seq) is idempotent from here on
+            self.dedup.record(stream, seq, n)
         now = time.time()
         n_alerts = len(alerts) + n_conn
         with self._alerts_lock:
@@ -413,16 +607,24 @@ class IngestManager:
                           stream=stream, rows=n, alerts=n_alerts)
         if n_alerts:
             logger.v(1).info("ingested %d rows, %d alerts", n, n_alerts)
-        return {"rows": n, "alerts": n_alerts}
+        out: Dict[str, object] = {"rows": n, "alerts": n_alerts}
+        if not scored:
+            # the producer sees its rows were stored but not scored —
+            # alert absence under brownout is degradation, not quiet
+            out["degraded"] = LEVEL_NAMES[level]
+        return out
 
     #: requests at least this slow land in the trace ring as
     #: "ingest.request" spans (fast ones only move the histograms)
     TRACE_SLOW_SECONDS = 0.1
 
-    def _timed_insert(self, batch: ColumnarBatch) -> int:
+    def _timed_insert(self, batch: ColumnarBatch,
+                      dedup: Optional[Tuple[str, int]] = None) -> int:
         t0 = time.perf_counter()
         try:
-            return self.db.insert_flows(batch)
+            if dedup is None:
+                return self.db.insert_flows(batch)
+            return self.db.insert_flows(batch, dedup=dedup)
         finally:
             _M_STAGE_STORE.observe(time.perf_counter() - t0)
 

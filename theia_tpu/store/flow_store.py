@@ -169,13 +169,20 @@ class Table:
             cols[col.name] = arr
         return ColumnarBatch(cols, self.dicts)
 
-    def insert(self, batch: ColumnarBatch) -> Optional[ColumnarBatch]:
+    def insert(self, batch: ColumnarBatch,
+               dedup: Optional[tuple] = None) -> Optional[ColumnarBatch]:
         """Insert a batch; returns the adopted (store-coded) batch, or
         None when empty, so callers can fan out the exact inserted block
         without re-reading the append log under concurrency. With a
         WAL attached, the record is journaled before the rows become
         visible — a failed append fails the insert (no ack without
-        durability)."""
+        durability). `dedup=(stream, seq[, total_rows])` stamps the
+        producer's batch identity (and the logical batch size — a
+        sharded insert journals per-slice) into the WAL record
+        (wal.pack_dedup_tag), making the acknowledgement itself
+        crash-durable: recovery replays the rows AND restores the
+        dedup-window entry from the same frame, so a retried batch is
+        idempotent across kill -9."""
         if len(batch) == 0:
             return None
         adopted = self._adopt(batch)
@@ -183,7 +190,16 @@ class Table:
         if hook is None:
             self._append_adopted(adopted)
         else:
-            hook(self.name, adopted, self._append_adopted)
+            name = self.name
+            if dedup is not None:
+                from .wal import pack_dedup_tag
+                stream, seq = dedup[0], int(dedup[1])
+                # the LOGICAL batch total (callers that know it pass
+                # it; a bare slice defaults to its own length)
+                total = (int(dedup[2]) if len(dedup) > 2
+                         and dedup[2] is not None else len(batch))
+                name = pack_dedup_tag(self.name, stream, seq, total)
+            hook(name, adopted, self._append_adopted)
         return adopted
 
     def _append_adopted(self, adopted: ColumnarBatch) -> None:
@@ -587,16 +603,24 @@ class FlowDatabase:
         #: fresh store or pre-WAL snapshot); attach_wal replays above
         #: these
         self._snapshot_lsns: List[int] = []
+        #: (stream, seq, rows) dedup tags recovered from replayed WAL
+        #: records — the ingest layer seeds its dedup window from
+        #: these so a producer retrying across a crash stays
+        #: exactly-once
+        self._recovered_acks: List[tuple] = []
 
     # -- ingest ------------------------------------------------------------
 
     def insert_flows(self, batch: ColumnarBatch,
-                     now: Optional[int] = None) -> int:
-        """Insert a flow batch; fan out to materialized views; evict TTL."""
+                     now: Optional[int] = None,
+                     dedup: Optional[tuple] = None) -> int:
+        """Insert a flow batch; fan out to materialized views; evict
+        TTL. `dedup=(stream, seq)` journals the producer's batch
+        identity with the rows (see Table.insert)."""
         # fires once per PHYSICAL store: once per replica in a
         # replicated fan-out, once per resync re-insert
         _fire_fault("store.insert", table="flows")
-        adopted = self.flows.insert(batch)
+        adopted = self.flows.insert(batch, dedup=dedup)
         if adopted is None:
             return 0
         # Views consume the adopted (store-coded) batch so their group
@@ -698,7 +722,14 @@ class FlowDatabase:
     def _replay_record(self, table: str, batch) -> None:
         """Apply one recovered WAL record. Runs before the hooks are
         installed, so nothing re-journals; flows go through the full
-        insert path (views, TTL) exactly like live ingest."""
+        insert path (views, TTL) exactly like live ingest. A dedup tag
+        in the record's table field restores the producer's ack to
+        `_recovered_acks` — rows and idempotency recover together."""
+        from .wal import split_dedup_tag
+        table, tag = split_dedup_tag(table)
+        if tag is not None:
+            self._recovered_acks.append((tag[0], tag[1], len(batch),
+                                         tag[2]))
         if table == "flows":
             self.insert_flows(batch)
         elif table in self.result_tables:
@@ -706,6 +737,28 @@ class FlowDatabase:
         else:
             _logger.error("WAL record for unknown table %r dropped "
                           "(%d rows)", table, len(batch))
+
+    def note_recovered_ack(self, stream: str, seq: int, rows: int,
+                           total: Optional[int] = None) -> None:
+        """Record an acknowledged (stream, seq) recovered outside the
+        normal replay path (foreign-topology WAL adoption)."""
+        self._recovered_acks.append((stream, int(seq), int(rows),
+                                     total))
+
+    def recovered_acks(self) -> List[tuple]:
+        """(stream, seq, recovered_rows, logical_total) tags restored
+        from WAL replay — the ingest layer's dedup-window seed after a
+        crash. recovered_rows < logical_total means part of the batch
+        was not durable at the crash (possible for sharded stores
+        under interval sync — slices fsync independently); the seeder
+        logs that loudly."""
+        return list(self._recovered_acks)
+
+    def wal_lag(self) -> int:
+        """Records appended but not yet fsynced (0 without a WAL) —
+        the admission plane's syncedLsn-lag pressure signal."""
+        wal = self._wal
+        return 0 if wal is None else wal.lag_records
 
     @contextlib.contextmanager
     def wal_suspended(self):

@@ -189,6 +189,9 @@ class ReplicatedFlowDatabase:
         # stats on every failover; these stay monotone instead.
         self._rows_inserted_total = 0
         self._bytes_inserted_total = 0
+        #: dedup tags adopted from stray WALs (each replica's own
+        #: recovered tags live in the replica; recovered_acks() merges)
+        self._recovered_acks: List[tuple] = []
 
     # -- replica membership ------------------------------------------------
 
@@ -354,9 +357,10 @@ class ReplicatedFlowDatabase:
                 self._quarantine(i, e)
             return out
 
-    def insert_flows(self, batch, now=None) -> int:
+    def insert_flows(self, batch, now=None, dedup=None) -> int:
         n = self._fanout(
-            lambda r: r.insert_flows(batch, now=now), "insert_flows")
+            lambda r: r.insert_flows(batch, now=now, dedup=dedup),
+            "insert_flows")
         nbytes = sum(np.asarray(a).nbytes
                      for a in batch.columns.values())
         with self._lock:
@@ -474,6 +478,39 @@ class ReplicatedFlowDatabase:
 
     def wal_stats(self) -> Optional[Dict[str, object]]:
         return self.active.wal_stats()
+
+    def wal_lag(self) -> int:
+        """Worst unsynced-record lag across live replicas (the
+        admission plane's pressure signal: the slowest copy sets the
+        real durability exposure)."""
+        lags = [r.wal_lag() for r in self.live()
+                if hasattr(r, "wal_lag")]
+        return max(lags) if lags else 0
+
+    def note_recovered_ack(self, stream: str, seq: int, rows: int,
+                           total: Optional[int] = None) -> None:
+        self._recovered_acks.append((stream, int(seq), int(rows),
+                                     total))
+
+    def recovered_acks(self) -> List[tuple]:
+        """Dedup tags recovered at attach_wal. Replica logs are COPIES
+        of the same logical stream, so the merge dedupes by
+        (stream, seq) (taking the max recovered count) instead of
+        summing — summing would multiply every ack by the replica
+        count."""
+        merged: Dict[tuple, List] = {}
+        for r in self.replicas:
+            ra = getattr(r, "recovered_acks", None)
+            if not callable(ra):
+                continue
+            for stream, seq, rows, total in ra():
+                ent = merged.setdefault((stream, seq), [0, None])
+                ent[0] = max(ent[0], rows)
+                if total is not None:
+                    ent[1] = max(ent[1] or 0, total)
+        out = [(k[0], k[1], v[0], v[1]) for k, v in merged.items()]
+        out.extend(self._recovered_acks)
+        return out
 
     def wal_sync(self) -> None:
         for r in self.live():

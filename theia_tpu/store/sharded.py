@@ -231,6 +231,9 @@ class ShardedFlowDatabase:
         #: per-shard WAL stamps from the loaded snapshot (see
         #: FlowDatabase._snapshot_lsns)
         self._snapshot_lsns: List[int] = []
+        #: dedup tags adopted from foreign-topology WALs (per-shard
+        #: tags live in the shards; recovered_acks() merges both)
+        self._recovered_acks: List[tuple] = []
 
     @property
     def n_shards(self) -> int:
@@ -249,9 +252,13 @@ class ShardedFlowDatabase:
     # -- ingest ----------------------------------------------------------
 
     def insert_flows(self, batch: ColumnarBatch,
-                     now: Optional[int] = None) -> int:
+                     now: Optional[int] = None,
+                     dedup: Optional[tuple] = None) -> int:
         """Route rows to shards (rand()); each shard maintains its own
-        views/TTL on its slice, like a ClickHouse shard does."""
+        views/TTL on its slice, like a ClickHouse shard does. A
+        `dedup` tag rides into every shard's WAL record (each slice
+        journals under the same (stream, seq), so recovery re-sums
+        the full batch's ack)."""
         if len(batch) == 0:
             return 0
         assign = self.flows._assign(len(batch))
@@ -264,8 +271,10 @@ class ShardedFlowDatabase:
         # replicas in parallel the same way.
         if len(parts) > 1 and (os.cpu_count() or 1) > 2:
             return sum(_shard_pool().map(
-                lambda sp: sp[0].insert_flows(sp[1], now=now), parts))
-        return sum(s.insert_flows(p, now=now) for s, p in parts)
+                lambda sp: sp[0].insert_flows(sp[1], now=now,
+                                              dedup=dedup), parts))
+        return sum(s.insert_flows(p, now=now, dedup=dedup)
+                   for s, p in parts)
 
     def insert_flow_rows(self, rows, now: Optional[int] = None) -> int:
         from ..schema import FLOW_SCHEMA
@@ -344,6 +353,33 @@ class ShardedFlowDatabase:
             "syncedLsn": [p["syncedLsn"] if p else None for p in per],
             "policy": live[0]["policy"],
         }
+
+    def wal_lag(self) -> int:
+        """Unsynced-record lag summed over shards (the admission
+        plane's cheap per-request pressure signal)."""
+        return sum(s.wal_lag() for s in self.shards)
+
+    def note_recovered_ack(self, stream: str, seq: int, rows: int,
+                           total: Optional[int] = None) -> None:
+        self._recovered_acks.append((stream, int(seq), int(rows),
+                                     total))
+
+    def recovered_acks(self) -> List[tuple]:
+        """Dedup tags recovered across every shard's WAL replay. A
+        batch split N ways journals its (stream, seq, logical total)
+        in N shard logs, each with its slice's row count — the merge
+        re-sums the slices into one logical ack; a sum short of the
+        total means some slice was not durable at the crash."""
+        merged: Dict[tuple, List] = {}
+        for s in self.shards:
+            for stream, seq, rows, total in s.recovered_acks():
+                ent = merged.setdefault((stream, seq), [0, None])
+                ent[0] += rows
+                if total is not None:
+                    ent[1] = max(ent[1] or 0, total)
+        out = [(k[0], k[1], v[0], v[1]) for k, v in merged.items()]
+        out.extend(self._recovered_acks)
+        return out
 
     def wal_position(self) -> Optional[List[int]]:
         pos = [s.wal_position() for s in self.shards]

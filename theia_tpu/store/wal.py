@@ -143,6 +143,55 @@ class WalError(Exception):
     """The log cannot take appends (failed write, closed, broken)."""
 
 
+# -- exactly-once dedup tags ----------------------------------------------
+#
+# A batch stamped with a producer (stream, seq) identity journals its
+# WAL record under a TAGGED table name, so the acknowledgement and the
+# rows are durable in the SAME frame: recovery restores the dedup
+# window exactly as far as it restores the rows, and a producer
+# retrying across a kill -9 cannot double-apply a replayed batch.
+# The unit separator cannot appear in a real table name, so untagged
+# records (and whole pre-existing logs) parse unchanged.
+
+_DEDUP_SEP = "\x1f"
+
+
+def pack_dedup_tag(table: str, stream: str, seq: int,
+                   total_rows: int) -> str:
+    """Encode a producer (stream, seq) identity plus the LOGICAL
+    batch row count into the record's table-name field. The total
+    lets recovery detect a partially-durable sharded batch (slices
+    journal independently under interval sync): a recovered ack whose
+    slice sum falls short of the total is loud, not silent."""
+    return (f"{table}{_DEDUP_SEP}{stream}{_DEDUP_SEP}{int(seq)}"
+            f"{_DEDUP_SEP}{int(total_rows)}")
+
+
+def split_dedup_tag(name: str
+                    ) -> Tuple[str,
+                               Optional[Tuple[str, int, Optional[int]]]]:
+    """Inverse of `pack_dedup_tag`: (table, (stream, seq, total) or
+    None). Stream ids are PRODUCER-CONTROLLED and may themselves
+    contain the separator, so the split anchors on the fields we own:
+    the table name (first — real table names never contain it) and
+    seq/total (the last two); everything between is the stream
+    verbatim. A malformed tag degrades to untagged (the rows still
+    replay; only the dedup entry is lost — at-least-once, the pre-tag
+    contract)."""
+    if _DEDUP_SEP not in name:
+        return name, None
+    parts = name.split(_DEDUP_SEP)
+    if len(parts) < 3:
+        return parts[0], None
+    try:
+        if len(parts) == 3:   # early tag layout without the total
+            return parts[0], (parts[1], int(parts[2]), None)
+        return parts[0], (_DEDUP_SEP.join(parts[1:-2]),
+                          int(parts[-2]), int(parts[-1]))
+    except ValueError:
+        return parts[0], None
+
+
 class WalCorruption(WalError):
     """A segment failed structural or checksum validation."""
 
@@ -861,6 +910,13 @@ class WriteAheadLog:
                              "LSN %d", self.dir, removed, lsn)
         return removed
 
+    @property
+    def lag_records(self) -> int:
+        """Records appended but not yet fsynced (the syncedLsn lag) —
+        cheap enough for the admission plane to poll per request,
+        unlike stats() which walks the segment directory."""
+        return self._dirty_records
+
     def stats(self) -> Dict[str, object]:
         """Health surface (served under /healthz `wal`)."""
         segs = self._list_segments()
@@ -980,8 +1036,19 @@ def _replay_dir_logically(db, path: str, stamp: int) -> int:
     scanner = WriteAheadLog(path, sync="never")
 
     def apply(table, batch):
+        table, tag = split_dedup_tag(table)
+        if tag is not None:
+            # preserve the producer identity across the topology
+            # change: the re-journaled record keeps its tag, and the
+            # recovered ack seeds the new manager's dedup window
+            note = getattr(db, "note_recovered_ack", None)
+            if callable(note):
+                note(tag[0], tag[1], len(batch), tag[2])
         if table == "flows":
-            db.insert_flows(batch)
+            if tag is not None:
+                db.insert_flows(batch, dedup=tag)
+            else:
+                db.insert_flows(batch)
         elif table in db.result_tables:
             db.result_tables[table].insert(batch)
         else:

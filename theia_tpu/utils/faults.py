@@ -42,6 +42,13 @@ Instrumented sites:
                       TRANSIENT_EXIT_CODE on an injected error so the
                       controller classifies it transient)
     reconciler.pass   DeclarativeReconciler.reconcile_once
+    admission.pressure  AdmissionController.admit, before any check:
+                      "error" forces the admission plane to reject the
+                      request (429 + Retry-After, reason "fault") —
+                      the deterministic overload drill; "hang" stalls
+                      the request inside admission. Combine with
+                      THEIA_ADMISSION_FORCE_LEVEL=<rung> to pin any
+                      brownout rung instead of just the reject rung.
 
 Modes: "error" raises FaultError (callers treat it like any I/O
 error); "hang" sleeps THEIA_FAULT_HANG_SECONDS (default 3600 — long
