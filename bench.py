@@ -60,10 +60,15 @@ def _kill_strays() -> None:
                 pass
 
 
-def _run_child(env: dict, timeout_s: float) -> bytes:
+def _run_child(env: dict, timeout_s: float):
     """Run the measurement in a child process (THEIA_BENCH_INNER=1) so
     a hung accelerator tunnel can be killed instead of hanging the
-    whole bench. Returns the child's stdout (the JSON line) or b''."""
+    whole bench. Returns (stdout, failure_reason): stdout is the JSON
+    line (b'' on failure); failure_reason is None, "timeout", or
+    "init failure (rc=N)" — the caller's retry decision hangs on the
+    distinction (a lease-wedged tunnel may recover, a platform that
+    failed to initialize will fail again immediately)."""
+    t0 = time.monotonic()
     try:
         child = subprocess.run(
             [sys.executable, os.path.abspath(__file__)],
@@ -72,12 +77,22 @@ def _run_child(env: dict, timeout_s: float) -> bytes:
     except subprocess.TimeoutExpired:
         print(f"bench child timed out after {timeout_s:.0f}s",
               file=sys.stderr)
-        return b""
+        return b"", "timeout"
     if child.returncode != 0:
-        print(f"bench child exited rc={child.returncode}",
-              file=sys.stderr)
-        return b""
-    return child.stdout.strip()
+        elapsed = time.monotonic() - t0
+        print(f"bench child exited rc={child.returncode} after "
+              f"{elapsed:.0f}s", file=sys.stderr)
+        # A fast nonzero exit is platform init failing deterministically
+        # (retrying hits the same wall); a child that ran a while and
+        # THEN died (OOM kill, flaky tunnel) is a transient crash the
+        # retry exists for.
+        if elapsed < 60.0:
+            return b"", f"init failure (rc={child.returncode})"
+        return b"", f"crash (rc={child.returncode})"
+    out = child.stdout.strip()
+    # rc=0 with no JSON line still needs a non-None reason: the caller
+    # branches on it (and an empty success should retry, not crash)
+    return out, (None if out else "no output (rc=0)")
 
 
 def main() -> None:
@@ -91,7 +106,12 @@ def main() -> None:
         print(json.dumps(run_benchmarks()))
         return
     _kill_strays()
-    timeout_s = float(os.environ.get("THEIA_BENCH_TIMEOUT", "420"))
+    # Device-attempt budget: THEIA_BENCH_DEVICE_TIMEOUT wins (BENCH_r05
+    # burned 2x420s before degrading; a host that knows its accelerator
+    # should cap the attempt tighter), legacy THEIA_BENCH_TIMEOUT next.
+    timeout_s = float(os.environ.get("THEIA_BENCH_DEVICE_TIMEOUT")
+                      or os.environ.get("THEIA_BENCH_TIMEOUT")
+                      or "420")
     # More than one accelerator attempt: a stale pool claim (a killed
     # TPU process earlier in the round) wedges the tunnel until its
     # lease expires — a second try minutes later can land on a
@@ -104,11 +124,23 @@ def main() -> None:
         attempts = 2   # never let a bad env var break the JSON line
     retry_wait = 120.0
     out = b""
+    degraded_reason = None
     for attempt in range(attempts):
         t_try = time.monotonic()
-        out = _run_child(dict(os.environ), timeout_s)
+        out, why = _run_child(dict(os.environ), timeout_s)
         if out:
             break
+        if why.startswith("init failure"):
+            # Platform init itself failed (fast, deterministic): the
+            # retry would hit the same wall — go straight to CPU.
+            degraded_reason = f"accelerator {why}"
+            print("platform init failed; skipping the retry",
+                  file=sys.stderr)
+            break
+        degraded_reason = (f"accelerator attempt timed out after "
+                           f"{timeout_s:.0f}s"
+                           if why == "timeout"
+                           else f"accelerator {why}")
         if attempt + 1 < attempts:
             # A fast failure re-hits the same unexpired lease; only
             # waiting gives the pool a chance to reclaim it.
@@ -120,14 +152,29 @@ def main() -> None:
             time.sleep(wait)
     if not out:
         print("retrying on the CPU backend (degraded)", file=sys.stderr)
-        out = _run_child(
+        # The CPU fallback gets its own budget: THEIA_BENCH_DEVICE_
+        # TIMEOUT caps accelerator attempts only — a tight device cap
+        # must not kill the fallback that exists to survive it.
+        cpu_timeout = float(os.environ.get("THEIA_BENCH_TIMEOUT")
+                            or "420")
+        out, _ = _run_child(
             {**os.environ, "JAX_PLATFORMS": "cpu",
-             "THEIA_BENCH_FAST": "1"}, timeout_s)
+             "THEIA_BENCH_FAST": "1"}, cpu_timeout)
+        if out and degraded_reason:
+            # stamp WHY the bench degraded, not just that it did
+            try:
+                doc = json.loads(out)
+                doc["degraded_reason"] = degraded_reason
+                out = json.dumps(doc).encode()
+            except ValueError:
+                pass
     if not out:
         out = json.dumps({
             "metric": "tad_ewma_scoring_records_per_sec", "value": 0,
             "unit": "records/s", "vs_baseline": 0.0,
             "error": "all backends failed or timed out; see stderr",
+            "degraded_reason": degraded_reason
+            or "all backends failed",
         }).encode()
     sys.stdout.buffer.write(out + b"\n")
     sys.stdout.flush()
@@ -358,6 +405,8 @@ def run_benchmarks() -> dict:
                 # execution that never happened and mis-name the cap).
                 t_dec = t_store = t_det = 0.0
                 best_total = float("inf")
+                stage_samples = {"decode": [], "store": [],
+                                 "detector": []}
                 for _ in range(2):
                     d2 = TsvDecoder()
                     db2 = FlowDatabase(ttl_seconds=12 * 3600)
@@ -368,6 +417,8 @@ def run_benchmarks() -> dict:
                     hh2.update(warm)
                     sd2.ingest(warm)
                     s_dec = s_store = s_det = 0.0
+                    samples = {"decode": [], "store": [],
+                               "detector": []}
                     for p in blocks[1:]:
                         ta = time.perf_counter()
                         b = d2.decode_block(p)
@@ -380,15 +431,31 @@ def run_benchmarks() -> dict:
                         s_dec += tb - ta
                         s_store += tc - tb
                         s_det += td - tc
+                        samples["decode"].append(tb - ta)
+                        samples["store"].append(tc - tb)
+                        samples["detector"].append(td - tc)
                     total = s_dec + s_store + s_det
                     if total < best_total:
                         best_total = total
                         t_dec, t_store, t_det = s_dec, s_store, s_det
+                        stage_samples = samples
+
+            def _p95_ms(xs):
+                xs = sorted(xs)
+                return round(
+                    xs[min(len(xs) - 1,
+                           int(round(0.95 * (len(xs) - 1))))] * 1e3,
+                    2)
             e2e_rate = n_e2e / dt
             e2e_stages = {
                 "decode_rows_per_sec": round(n_e2e / t_dec),
                 "store_rows_per_sec": round(n_e2e / t_store),
                 "detector_rows_per_sec": round(n_e2e / t_det),
+                # per-block p95 latency per stage: mean rates hide the
+                # tail (one slow MV fan-out or jit retrace per pass)
+                "decode_p95_ms": _p95_ms(stage_samples["decode"]),
+                "store_p95_ms": _p95_ms(stage_samples["store"]),
+                "detector_p95_ms": _p95_ms(stage_samples["detector"]),
             }
             # The ingest path runs the store and detector legs
             # OVERLAPPED (manager/ingest.py pipelining), so the
@@ -534,6 +601,164 @@ def run_benchmarks() -> dict:
     except Exception as e:
         import traceback
         print(f"e2e bench skipped: {e}", file=sys.stderr)
+        traceback.print_exc(file=sys.stderr)
+
+    # Fused-engine legs (the device-resident scoring pipeline,
+    # ingest/device_path.py). The engine-parity gate runs FIRST — the
+    # same block sequence must yield the same alert stream from both
+    # engines before any fused timing is trusted — then the fused
+    # detector leg (the comparable to e2e_stages.detector_rows_per_sec:
+    # same blocks, same single-shard detector state, but ONE fused
+    # dispatch with reused staging buffers instead of two dispatches +
+    # two fetches per block) and the fused end-to-end ingest number.
+    # THEIA_BENCH_FAST=1 runs only the one-micro-batch parity smoke,
+    # so a kernel regression fails fast without the full bench.
+    fused_parity_ok = None
+    fused_det_rate = 0.0
+    sharded_det_2s = 0.0
+    fused_e2e = 0.0
+    try:
+        import contextlib
+        import gc as _fgc
+
+        from theia_tpu.ingest import BlockEncoder as _FEnc
+        from theia_tpu.ingest import TsvDecoder as _FDec
+        from theia_tpu.ingest import native_available as _f_native
+        from theia_tpu.manager.ingest import IngestManager as _FIm
+        from theia_tpu.store import FlowDatabase as _FDb
+
+        if _f_native():
+            fast = os.environ.get("THEIA_BENCH_FAST") == "1"
+
+            def cpu_ctx_f():
+                try:
+                    return jax.default_device(jax.devices("cpu")[0])
+                except Exception:
+                    return contextlib.nullcontext()
+
+            cfgf = (SynthConfig(n_series=200, points_per_series=10)
+                    if fast else
+                    SynthConfig(n_series=2000, points_per_series=30))
+            bigf = generate_flows(cfgf)
+            encf = _FEnc(dicts=bigf.dicts)
+            blocksf = [encf.encode(bigf)
+                       for _ in range(3 if fast else 9)]
+            decf = _FDec()
+            batches = [decf.decode_block(p) for p in blocksf]
+
+            def _strip(conn):
+                return [{k: v for k, v in d.items()
+                         if k != "latency_s"} for d in conn]
+
+            with cpu_ctx_f():
+                # parity gate — before any timed window
+                im_s = _FIm(_FDb(), n_shards=4)
+                im_f = _FIm(_FDb(), n_shards=4, engine="fused")
+                fused_parity_ok = True
+                for b in batches[:3]:
+                    hs, cs, ns = im_s.score_batch(b)
+                    hf, cf, nf = im_f.score_batch(b)
+                    if not (hs == hf and ns == nf
+                            and _strip(cs) == _strip(cf)):
+                        fused_parity_ok = False
+                im_f.close()
+                im_s.close()
+                print("fused engine parity: "
+                      + ("ok" if fused_parity_ok else "MISMATCH"),
+                      file=sys.stderr)
+                _fgc.collect()
+
+                if not fast and fused_parity_ok:
+                    # Detector-leg comparison at the pipeline's design
+                    # point: two concurrent producer streams (distinct
+                    # flow populations), so double-buffered staging
+                    # overlaps device scoring and coalescing can fold
+                    # blocks — the same structure for both engines so
+                    # the fused number is an apples win, not a
+                    # measurement artifact. Sequential single-stream
+                    # rates go to stderr for the record.
+                    import threading as _fthr
+
+                    stream_batches = []
+                    for sid in range(2):
+                        bs = generate_flows(SynthConfig(
+                            n_series=2000, points_per_series=30,
+                            seed=sid))
+                        es = _FEnc(dicts=bs.dicts)
+                        ds = _FDec()
+                        stream_batches.append(
+                            [ds.decode_block(es.encode(bs))
+                             for _ in range(9)])
+                    rows2 = sum(len(b) for st in stream_batches
+                                for b in st[1:])
+
+                    def det_leg(engine_name):
+                        imd = _FIm(_FDb(), n_shards=2,
+                                   engine=engine_name)
+                        for st in stream_batches:   # warm jit + ring
+                            imd.score_batch(st[0])
+                        # sequential single-stream rate (diagnostic)
+                        t0f = time.perf_counter()
+                        for b in stream_batches[0][1:]:
+                            imd.score_batch(b)
+                        seq = (len(stream_batches[0][1:])
+                               * len(stream_batches[0][0])
+                               / (time.perf_counter() - t0f))
+
+                        def feed(st):
+                            for b in st[1:]:
+                                imd.score_batch(b)
+                        best = float("inf")
+                        for _ in range(2):   # best-of-2 vs CPU steal
+                            th = [_fthr.Thread(target=feed,
+                                               args=(st,))
+                                  for st in stream_batches]
+                            t0f = time.perf_counter()
+                            for t in th:
+                                t.start()
+                            for t in th:
+                                t.join()
+                            best = min(best,
+                                       time.perf_counter() - t0f)
+                        imd.close()
+                        del imd
+                        _fgc.collect()
+                        return rows2 / best, seq
+
+                    sharded_2s, sharded_seq = det_leg("sharded")
+                    sharded_det_2s = sharded_2s
+                    fused_det_rate, fused_seq = det_leg("fused")
+                    print(f"fused detector leg (2 streams): "
+                          f"{fused_det_rate:,.0f} rows/s vs sharded "
+                          f"{sharded_2s:,.0f} rows/s "
+                          f"[sequential: fused {fused_seq:,.0f}, "
+                          f"sharded {sharded_seq:,.0f}; e2e-leg "
+                          f"attribution "
+                          f"{e2e_stages.get('detector_rows_per_sec', 0):,}]",
+                          file=sys.stderr)
+
+                    best = 0.0
+                    for _ in range(2):
+                        enc2 = _FEnc(dicts=bigf.dicts)
+                        payloads = [enc2.encode(bigf)
+                                    for _ in range(9)]
+                        imf = _FIm(_FDb(ttl_seconds=12 * 3600),
+                                   engine="fused")
+                        imf.ingest(payloads[0])   # warm dicts + jit
+                        t0f = time.perf_counter()
+                        nf2 = sum(imf.ingest(p)["rows"]
+                                  for p in payloads[1:])
+                        best = max(best,
+                                   nf2 / (time.perf_counter() - t0f))
+                        imf.close()
+                        del imf, payloads
+                        _fgc.collect()
+                    fused_e2e = best
+                    print(f"fused e2e ingest: {best:,.0f} rows/s",
+                          file=sys.stderr)
+    except Exception as e:
+        import traceback
+        print(f"fused bench skipped: {e}", file=sys.stderr)
         traceback.print_exc(file=sys.stderr)
 
     # Instrumentation overhead: the full IngestManager path with the
@@ -910,6 +1135,16 @@ def run_benchmarks() -> dict:
         result["wal_recovery_rows_per_sec"] = round(wal_recovery)
     if overload:
         result.update(overload)
+    if fused_parity_ok is not None:
+        result["fused_parity_ok"] = fused_parity_ok
+    if fused_det_rate:
+        result["fused_detector_rows_per_sec"] = round(fused_det_rate)
+    if sharded_det_2s:
+        # the same 2-stream structure on the sharded engine — the
+        # apples comparable for fused_detector_rows_per_sec
+        result["detector_2stream_rows_per_sec"] = round(sharded_det_2s)
+    if fused_e2e:
+        result["e2e_ingest_fused_rows_per_sec"] = round(fused_e2e)
     if e2e_stages:
         result["e2e_stages"] = e2e_stages
     if e2e_scaling:

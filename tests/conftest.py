@@ -11,22 +11,44 @@ import os
 # Force CPU even if the ambient environment points JAX at an accelerator:
 # tests validate numerics in float64 (golden comparisons) and sharding on
 # 8 virtual devices, neither of which wants the single real chip.
-os.environ["JAX_PLATFORMS"] = "cpu"
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+# THEIA_TEST_DEVICE=1 opts OUT of the forcing so the `device`-marked
+# hardware tests can actually reach the chip (run them selected:
+# `THEIA_TEST_DEVICE=1 pytest -m device`); everything else in the suite
+# assumes the CPU/x64 configuration and is not supported in that mode.
+_device_mode = os.environ.get("THEIA_TEST_DEVICE") == "1"
+if not _device_mode:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    xla_flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in xla_flags:
+        os.environ["XLA_FLAGS"] = (
+            xla_flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 import jax  # noqa: E402
 
-jax.config.update("jax_enable_x64", True)
-# The axon sitecustomize hook sets jax_platforms programmatically
-# ("axon,cpu"), which overrides the env var — force it back before any
-# backend initializes.
-jax.config.update("jax_platforms", "cpu")
+if not _device_mode:
+    jax.config.update("jax_enable_x64", True)
+    # The axon sitecustomize hook sets jax_platforms programmatically
+    # ("axon,cpu"), which overrides the env var — force it back before
+    # any backend initializes.
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+
+def pytest_collection_modifyitems(config, items):
+    """Auto-skip `device`-marked tests when no accelerator backs JAX:
+    tier-1 runs with JAX_PLATFORMS=cpu (forced above), so accelerator
+    parity tests never flake CI and still run on real hardware."""
+    if jax.default_backend() != "cpu":
+        return
+    skip = pytest.mark.skip(
+        reason="requires a real accelerator (device marker; "
+               "JAX is on the cpu backend)")
+    for item in items:
+        if "device" in item.keywords:
+            item.add_marker(skip)
 
 
 @pytest.fixture(scope="session")

@@ -37,18 +37,27 @@ instead of O(T·capacity) dense dispatches, with U ≤ rows.
 from __future__ import annotations
 
 import time
-from typing import Dict, List, NamedTuple, Optional, Tuple
+from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import metrics as _metrics
 from ..ops.ewma import DEFAULT_ALPHA
 from ..schema import ColumnarBatch
 
 CONNECTION_KEY_COLUMNS = (
     "sourceIP", "sourceTransportPort", "destinationIP",
     "destinationTransportPort", "protocolIdentifier", "flowStartSeconds")
+
+# Capacity overflow is silent at the data plane (new series simply stop
+# being scored) — this counter is the operator's only line-rate signal
+# that alerts are going missing before they do.
+_M_DROPPED = _metrics.counter(
+    "theia_detector_series_dropped_total",
+    "New connection series dropped because every streaming-detector "
+    "slot was taken (the series is never scored)")
 
 
 class StreamState(NamedTuple):
@@ -134,6 +143,48 @@ def _pad_pow2(n: int, minimum: int) -> int:
     return size
 
 
+class StreamPlan(NamedTuple):
+    """Host half of one micro-batch: the [T, U] tick tile plus the slot
+    gather/scatter vector, ready for the jitted device step. Built by
+    `StreamingDetector.build_plan` and consumed either by this module's
+    `stream_update_sparse` (sharded engine) or by the fused engine's
+    single cross-shard dispatch (ops/fused_detector.py)."""
+    slots: np.ndarray     # [U_pad] int32; padding holds `capacity`
+    x: np.ndarray         # [T_pad, U_pad] float32 values
+    active: np.ndarray    # [T_pad, U_pad] bool validity
+    row_idx: np.ndarray   # [T_pad, U_pad] int64 source row (-1 padding)
+    present: np.ndarray   # [U] slot id per live column
+
+
+def alert_record(slot: int, flow_end: int, value: float,
+                 latency: float) -> Dict[str, object]:
+    """The connection-anomaly alert record — ONE builder for both
+    engines (this module's ingest path and the fused engine's
+    device_path._finish) so the published shape cannot drift."""
+    return {
+        "slot": int(slot),
+        "flowEndSeconds": int(flow_end),
+        "throughput": float(value),
+        "latency_s": latency,
+    }
+
+
+def plan_alerts(plan: StreamPlan, hits: np.ndarray, times: np.ndarray,
+                values: np.ndarray,
+                latency: float) -> List[Dict[str, object]]:
+    """Alert records for the anomaly hits of one plan's device step
+    (sharded engine; `row` is batch-local and popped before
+    publication by describe_alert's caller)."""
+    alerts: List[Dict[str, object]] = []
+    for t, c in hits:
+        i = int(plan.row_idx[t, c])
+        rec = alert_record(plan.present[c], times[i], values[i],
+                           latency)
+        rec["row"] = i
+        alerts.append(rec)
+    return alerts
+
+
 class StreamingDetector:
     """Host-side driver: key→slot mapping + device-resident state."""
 
@@ -166,6 +217,7 @@ class StreamingDetector:
             if self._n_alloc >= self.capacity:
                 self._slots[key] = -1
                 self.dropped_series += 1
+                _M_DROPPED.inc()
                 return -1
             slot = self._n_alloc
             self._n_alloc += 1
@@ -173,28 +225,24 @@ class StreamingDetector:
             self._slot_keys.append(key)
         return slot
 
-    def ingest(self, batch: ColumnarBatch) -> List[Dict[str, object]]:
-        """Advance state with one micro-batch; returns alert records.
+    def build_plan(self, keys: np.ndarray, values: np.ndarray,
+                   staging: Optional[Callable] = None
+                   ) -> Optional[StreamPlan]:
+        """Host half of `ingest`: key→slot mapping plus the [T, U]
+        tick tile for one micro-batch, no device work.
 
-        Rows are keyed by the 6-tuple connection columns; if a batch
-        carries several points for one connection, each lands in a
-        successive tick so the recurrence sees them in order. Python
-        work is O(distinct NEW connections), not O(rows): keys are
-        packed into 48-byte rows and deduplicated vectorized, and the
-        whole batch is one jitted gather-scan-scatter device step.
+        `keys` is the [N, 6] int64 connection-key matrix (in
+        CONNECTION_KEY_COLUMNS order), `values` the [N] metric column.
+        `staging(tag, shape, dtype)` returns a reusable array to fill
+        — the fused engine's pinned ring; None allocates fresh arrays
+        (this class's own path). Returns None when no row maps to a
+        live slot.
+
+        Python work is O(distinct NEW connections), not O(rows): keys
+        are packed into 48-byte rows and deduplicated vectorized, and
+        the Python dict is touched once per distinct key.
         """
-        if len(batch) == 0:
-            return []
-        t_arrival = self.clock()
-        keys = np.ascontiguousarray(np.stack(
-            [np.asarray(batch[c], np.int64)
-             for c in CONNECTION_KEY_COLUMNS], axis=1))
-        values = np.asarray(batch[self.value_column], np.float64)
-        times = np.asarray(batch["flowEndSeconds"], np.int64)
-
-        # Vectorized key→slot: dedupe packed key rows, then touch the
-        # Python dict once per distinct key (amortized: once per NEW
-        # key for a steady connection population).
+        keys = np.ascontiguousarray(keys, dtype=np.int64)
         packed = keys.view(np.dtype((np.void, keys.itemsize *
                                      keys.shape[1]))).ravel()
         uniq, inverse = np.unique(packed, return_inverse=True)
@@ -213,7 +261,7 @@ class StreamingDetector:
         # position minus the start index of the slot's run.
         n = len(s_sorted)
         if n == 0:
-            return []
+            return None
         same = np.empty(n, bool)
         same[0] = False
         same[1:] = s_sorted[1:] == s_sorted[:-1]
@@ -230,33 +278,52 @@ class StreamingDetector:
         u = len(present)
         u_pad = _pad_pow2(u, 64)
         t_pad = _pad_pow2(n_ticks, 1)
-        x = np.zeros((t_pad, u_pad), np.float32)
-        active = np.zeros((t_pad, u_pad), bool)
-        row_idx = np.full((t_pad, u_pad), -1, np.int64)
+
+        def _alloc(tag, shape, dtype, fill):
+            if staging is None:
+                return np.full(shape, fill, dtype)
+            a = staging(tag, shape, dtype)
+            a[...] = fill
+            return a
+
+        x = _alloc("x", (t_pad, u_pad), np.float32, 0)
+        active = _alloc("active", (t_pad, u_pad), bool, False)
+        row_idx = _alloc("row_idx", (t_pad, u_pad), np.int64, -1)
         x[tick, col] = v_sorted
         active[tick, col] = True
         row_idx[tick, col] = idx_sorted
-        slots_pad = np.full(u_pad, self.capacity, np.int32)
+        slots_pad = _alloc("slots", (u_pad,), np.int32, self.capacity)
         slots_pad[:u] = present
+        return StreamPlan(slots_pad, x, active, row_idx, present)
+
+    def ingest(self, batch: ColumnarBatch) -> List[Dict[str, object]]:
+        """Advance state with one micro-batch; returns alert records.
+
+        Rows are keyed by the 6-tuple connection columns; if a batch
+        carries several points for one connection, each lands in a
+        successive tick so the recurrence sees them in order. The
+        whole batch is one jitted gather-scan-scatter device step.
+        """
+        if len(batch) == 0:
+            return []
+        t_arrival = self.clock()
+        keys = np.stack(
+            [np.asarray(batch[c], np.int64)
+             for c in CONNECTION_KEY_COLUMNS], axis=1)
+        values = np.asarray(batch[self.value_column], np.float64)
+        times = np.asarray(batch["flowEndSeconds"], np.int64)
+        plan = self.build_plan(keys, values)
+        if plan is None:
+            return []
         self.state, anomaly = stream_update_sparse(
-            self.state, jnp.asarray(slots_pad), jnp.asarray(x),
-            jnp.asarray(active), self.alpha)
+            self.state, jnp.asarray(plan.slots), jnp.asarray(plan.x),
+            jnp.asarray(plan.active), self.alpha)
 
         hits = np.argwhere(np.asarray(anomaly))
         if not hits.size:
             return []
         latency = self.clock() - t_arrival
-        alerts: List[Dict[str, object]] = []
-        for t, c in hits:
-            i = int(row_idx[t, c])
-            alerts.append({
-                "slot": int(present[c]),
-                "row": i,
-                "flowEndSeconds": int(times[i]),
-                "throughput": float(values[i]),
-                "latency_s": latency,
-            })
-        return alerts
+        return plan_alerts(plan, hits, times, values, latency)
 
     def describe_alert(self, batch: ColumnarBatch,
                        alert: Dict[str, object]) -> Dict[str, object]:

@@ -733,6 +733,23 @@ def top(args) -> None:
                                        ()), 0.0)
                 print(f"admission: {names[i_lvl]} (rung {i_lvl}, "
                       f"pressure {pressure:.2f})")
+            qd = sample.get(("theia_fused_queue_depth", ()))
+            if qd is not None:
+                # fused-engine header: pipeline backlog + step rate +
+                # coalesced rows/step, from scrape-to-scrape deltas
+                def _delta(name):
+                    if prev is None:
+                        return 0.0
+                    return max(sample.get((name, ()), 0.0)
+                               - prev.get((name, ()), 0.0), 0.0)
+                steps = _delta("theia_fused_steps_total")
+                step_rows = _delta("theia_fused_batch_rows_sum")
+                dt_s = now - prev_t if prev is not None else 0.0
+                print(f"fused engine: queue depth {qd:.0f}, "
+                      f"{steps / dt_s if dt_s > 0 else 0.0:,.1f} "
+                      f"steps/s, "
+                      f"{step_rows / steps if steps > 0 else 0.0:,.0f}"
+                      f" rows/step")
             if rows:
                 _print_table(rows, ["METRIC", "LABELS", "RATE/s",
                                     "VALUE"])

@@ -456,6 +456,14 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
                 "theia_detector_series",
                 "Tracked connection series across detector shards"
             ).set(sum(s["series"] for s in live["perShard"]))
+            # Slot saturation pair: live vs capacity — read them with
+            # theia_detector_series_dropped_total, which counts the
+            # series silently turned away once every slot is taken.
+            _obs_metrics.gauge(
+                "theia_detector_series_capacity",
+                "Total streaming-detector slot capacity across shards"
+            ).set(sum(s.get("capacity", 0)
+                      for s in live["perShard"]))
             _obs_metrics.gauge(
                 "theia_ingest_insert_inflight",
                 "Store-insert legs submitted but not finished (the "

@@ -151,6 +151,19 @@ def default_ingest_shards() -> int:
     return max(1, n)
 
 
+#: selectable scoring engines (THEIA_DETECTOR_ENGINE): "sharded" is
+#: today's per-shard-lock path; "fused" is the device-resident
+#: coalescing pipeline (ingest/device_path.py) — a drop-in with the
+#: same alert semantics, kept opt-in until bench proves the win per
+#: host class
+DETECTOR_ENGINES = ("sharded", "fused")
+
+
+def default_detector_engine() -> str:
+    name = os.environ.get("THEIA_DETECTOR_ENGINE", "").strip().lower()
+    return name or "sharded"
+
+
 class StreamCapacityError(Exception):
     """All stream slots are held by active producers (→ HTTP 503:
     retryable capacity condition, not a payload error)."""
@@ -211,7 +224,9 @@ class IngestManager:
     def __init__(self, db, detector: Optional[HeavyHitterDetector] = None,
                  streaming: Optional[StreamingDetector] = None,
                  n_shards: Optional[int] = None,
-                 admission: Optional[AdmissionController] = None
+                 admission: Optional[AdmissionController] = None,
+                 engine: Optional[str] = None,
+                 streaming_capacity: Optional[int] = None
                  ) -> None:
         self.db = db
         self._streams: Dict[str, _Stream] = {}
@@ -224,18 +239,39 @@ class IngestManager:
         elif n_shards is None:
             n_shards = default_ingest_shards()
         self.n_shards = max(1, int(n_shards))
+        engine = (engine or default_detector_engine()).strip().lower()
+        if engine not in DETECTOR_ENGINES:
+            raise ValueError(
+                f"unknown detector engine {engine!r} "
+                f"(THEIA_DETECTOR_ENGINE): expected one of "
+                f"{DETECTOR_ENGINES}")
+        self.engine_name = engine
+        _stream_kwargs = ({"capacity": int(streaming_capacity)}
+                          if streaming_capacity else {})
         self.shards: List[DetectorShard] = [
             DetectorShard(i,
                           detector if detector is not None
                           else HeavyHitterDetector(),
                           streaming if streaming is not None
-                          else StreamingDetector())
+                          else StreamingDetector(**_stream_kwargs))
             for i in range(self.n_shards)]
         # Last-published CMS total per shard: peers read these without
         # taking the owner's lock, so heavy-hitter shares measure an
         # eventually-consistent cluster total instead of serializing
         # every shard on every batch.
         self._shard_totals = np.zeros(self.n_shards, np.float64)
+        # Fused engine: same DetectorShard state objects, scored by
+        # the coalescing single-dispatch pipeline instead of the
+        # per-shard-lock loop below. Imported lazily — the module
+        # pulls in the fused kernels, which a sharded-only manager
+        # never needs.
+        self._fused = None
+        if engine == "fused":
+            from ..ingest.device_path import FusedDetectorEngine
+            self._fused = FusedDetectorEngine(
+                self.shards, self._shard_totals,
+                on_scored=lambda n, stripe: _M_SCORED.inc(
+                    n, stripe=stripe))
         # The alert ring has its own cheap lock: GET /alerts never
         # waits behind scoring or JIT compilation.
         self._alerts_lock = threading.Lock()
@@ -298,6 +334,16 @@ class IngestManager:
             self.admission.add_signal(
                 "walLag", self._wal_lag,
                 env_int("THEIA_WAL_LAG_HIGH", 50_000))
+            if self._fused is not None:
+                # Fused-pipeline backlog: a slow/wedged device step
+                # fills the bounded queue; crossing the watermark
+                # walks the brownout ladder (sampled scoring → shed
+                # detector → reject) instead of stacking requests
+                # behind an invisible device stall.
+                self.admission.add_signal(
+                    "fusedQueue", self._fused.queue_depth,
+                    env_int("THEIA_FUSED_QUEUE_HIGH", 0)
+                    or self._fused.queue_capacity)
         # Exactly-once retried ingest: (stream, seq)-stamped batches
         # dedup against this window; recovery re-seeds it from the
         # tags the WAL replay surfaced, so the idempotency contract
@@ -374,6 +420,11 @@ class IngestManager:
         store, fault drill) must not stall shutdown past the WAL
         fsync and final checkpoint. `drain=False` is for tests
         tearing down a deliberately wedged pool."""
+        if self._fused is not None:
+            # the fused scorer drains its queued steps and exits; done
+            # before the insert drain so in-flight requests' scoring
+            # legs resolve while their insert legs settle
+            self._fused.close()
         if drain:
             import concurrent.futures as _cf
             with self._inflight_lock:
@@ -641,6 +692,12 @@ class IngestManager:
         if len(batch) == 0:
             return [], [], 0
         scored, shard_ids = self._remap_global(batch)
+        if self._fused is not None:
+            # Fused engine: the remapped batch rides the coalescing
+            # device pipeline (ingest/device_path.py) — no shard
+            # locks, no per-shard slicing; per-shard order is the
+            # pipeline's enqueue order.
+            return self._fused.score(scored, shard_ids)
         hh_alerts: List = []
         raw_alerts: List[Tuple[DetectorShard, ColumnarBatch, Dict]] = []
         n_conn = 0
@@ -797,11 +854,17 @@ class IngestManager:
                 "shard": s.index,
                 "busy": not acquired,
                 "series": int(s.streaming.n_series),
+                "capacity": int(s.streaming.capacity),
+                "droppedSeries": int(s.streaming.dropped_series),
             })
+        engine: Dict[str, object] = {"name": self.engine_name}
+        if self._fused is not None:
+            engine.update(self._fused.stats())
         return {
             "shards": self.n_shards,
             "streams": len(self._streams),
             "rowsIngested": self.rows_ingested,
+            "engine": engine,
             "perShard": per_shard,
         }
 
