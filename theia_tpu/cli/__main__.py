@@ -733,6 +733,28 @@ def top(args) -> None:
                                        ()), 0.0)
                 print(f"admission: {names[i_lvl]} (rung {i_lvl}, "
                       f"pressure {pressure:.2f})")
+            pc = sample.get(("theia_store_parts", ()))
+            if pc is not None:
+                # parts-engine header: part count, tier residency,
+                # merge rate from scrape-to-scrape deltas
+                hot = sample.get(
+                    ("theia_store_part_bytes", (("tier", "hot"),)),
+                    0.0)
+                cold = sample.get(
+                    ("theia_store_part_bytes", (("tier", "cold"),)),
+                    0.0)
+                dt_p = now - prev_t if prev is not None else 0.0
+                dm = 0.0
+                if prev is not None:
+                    dm = max(sample.get(
+                        ("theia_store_merges_total", ()), 0.0)
+                        - prev.get(("theia_store_merges_total", ()),
+                                   0.0), 0.0)
+                print(f"parts engine: {pc:,.0f} parts, "
+                      f"hot {hot / 1e6:,.1f} MB, "
+                      f"cold {cold / 1e6:,.1f} MB, "
+                      f"{dm / dt_p if dt_p > 0 else 0.0:,.2f} "
+                      f"merges/s")
             qd = sample.get(("theia_fused_queue_depth", ()))
             if qd is not None:
                 # fused-engine header: pipeline backlog + step rate +

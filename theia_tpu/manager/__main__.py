@@ -188,14 +188,38 @@ def main(argv=None) -> None:
     if ttl is None:
         ttl = env_int("THEIA_TTL_SECONDS", 0) or None
 
+    # Storage engine (THEIA_STORE_ENGINE=parts|flat, default flat):
+    # the parts engine seals ingest into compressed column parts and
+    # needs a directory for its cold tier + manifest — default
+    # `<db path>.parts` beside the snapshot, THEIA_STORE_COLD_DIR
+    # overrides, in-memory-only (pruning/compression, no tiering or
+    # manifest recovery) when neither exists.
+    from ..store import default_store_engine
+    store_engine = default_store_engine()
+    parts_dir = None
+    if store_engine == "parts":
+        parts_dir = (os.environ.get("THEIA_STORE_COLD_DIR")
+                     or (args.db + ".parts" if args.db else None))
+        print(f"store engine: parts"
+              + (f" (part dir {parts_dir})" if parts_dir else
+                 " (in-memory, no part directory)"),
+              file=sys.stderr)
+
     if args.replicas > 1:
+        import itertools
+
         from ..store import ReplicatedFlowDatabase
+        _replica_seq = itertools.count()
 
         def _factory():
+            idx = next(_replica_seq)
+            rdir = (os.path.join(parts_dir, f"replica-{idx:03d}")
+                    if parts_dir else None)
             if args.shards > 1:
                 return ShardedFlowDatabase(n_shards=args.shards,
-                                           ttl_seconds=ttl)
-            return FlowDatabase(ttl_seconds=ttl)
+                                           ttl_seconds=ttl,
+                                           parts_dir=rdir)
+            return FlowDatabase(ttl_seconds=ttl, parts_dir=rdir)
 
         # Loads go through the loader even when the primary file is
         # missing: read_snapshot falls back to <path>.prev (the crash
@@ -208,6 +232,10 @@ def main(argv=None) -> None:
                 db = ReplicatedFlowDatabase.load(
                     args.db, replicas=args.replicas, factory=_factory)
             except FileNotFoundError:
+                # the failed load consumed replica indices — restart
+                # numbering so part dirs stay replica-000..N across
+                # runs (a drifting numbering would strand old files)
+                _replica_seq = itertools.count()
                 db = ReplicatedFlowDatabase(replicas=args.replicas,
                                             factory=_factory)
         else:
@@ -218,20 +246,24 @@ def main(argv=None) -> None:
             try:
                 db = ShardedFlowDatabase.load(args.db,
                                               n_shards=args.shards,
-                                              ttl_seconds=ttl)
+                                              ttl_seconds=ttl,
+                                              parts_dir=parts_dir)
             except FileNotFoundError:
                 db = ShardedFlowDatabase(n_shards=args.shards,
-                                         ttl_seconds=ttl)
+                                         ttl_seconds=ttl,
+                                         parts_dir=parts_dir)
         else:
             db = ShardedFlowDatabase(n_shards=args.shards,
-                                     ttl_seconds=ttl)
+                                     ttl_seconds=ttl,
+                                     parts_dir=parts_dir)
     elif args.db:
         try:
-            db = FlowDatabase.load(args.db, ttl_seconds=ttl)
+            db = FlowDatabase.load(args.db, ttl_seconds=ttl,
+                                   parts_dir=parts_dir)
         except FileNotFoundError:
-            db = FlowDatabase(ttl_seconds=ttl)
+            db = FlowDatabase(ttl_seconds=ttl, parts_dir=parts_dir)
     else:
-        db = FlowDatabase(ttl_seconds=ttl)
+        db = FlowDatabase(ttl_seconds=ttl, parts_dir=parts_dir)
     wal_dir = args.wal_dir or os.environ.get("THEIA_WAL_DIR") or None
     if wal_dir:
         # Attach BEFORE synth seeding / serving: recovery replays the

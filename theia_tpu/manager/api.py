@@ -210,6 +210,7 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
     profiles = None   # ProfileManager
     ingest = None     # IngestManager
     retention = None  # RetentionLoop
+    maintenance = None  # PartMaintenanceLoop (parts engine)
     auth_token: Optional[str] = None
     quiet = True
     # Socket timeout (StreamRequestHandler honors it): a client that
@@ -484,6 +485,24 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
                 "theia_retention_usage_percent",
                 "Store bytes vs retention capacity").set(
                     self.retention.stats()["usagePercent"])
+        try:
+            # the getattr itself can raise on a replicated store with
+            # every replica down (__getattr__ resolves via `active`)
+            parts = db.store_stats().get("parts")
+        except Exception:
+            parts = None
+        if parts:
+            _obs_metrics.gauge(
+                "theia_store_parts",
+                "Sealed column parts in the flows table (parts "
+                "engine)").set(parts["count"])
+            pb = _obs_metrics.gauge(
+                "theia_store_part_bytes",
+                "Sealed-part bytes by tier: hot = resident "
+                "encoded chunks, cold = on-disk part files",
+                labelnames=("tier",))
+            pb.labels(tier="hot").set(parts["hotBytes"])
+            pb.labels(tier="cold").set(parts["coldBytes"])
         raw = _obs_prom.render().encode()
         self.send_response(200)
         self.send_header("Content-Type", _obs_prom.CONTENT_TYPE)
@@ -526,6 +545,19 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
                 doc["status"] = "degraded"
         if self.retention is not None:
             doc["retention"] = self.retention.stats()
+        # Storage engine + tier summary (parts engine: part counts,
+        # hot/cold bytes, memtable, merge/seal/demote totals). The
+        # attribute lookup itself can raise on a replicated store with
+        # every replica down — healthz must keep serving `degraded`.
+        try:
+            store_doc = db.store_stats()
+        except Exception:
+            store_doc = None
+        if store_doc:
+            maint = getattr(self, "maintenance", None)
+            if maint is not None:
+                store_doc["maintenance"] = maint.stats()
+            doc["store"] = store_doc
         # WAL health: segment count/bytes and the ack-durability lag
         # (records/bytes appended but not yet fsynced under the sync
         # policy) — the operator's read on the current loss bound.
@@ -824,6 +856,22 @@ class TheiaManagerServer:
                         capacity_bytes))
             self.retention = RetentionLoop(monitor,
                                            interval=retention_interval)
+        # Parts engine → supervised background merge loop (compacts
+        # small sealed parts; THEIA_STORE_MERGE_INTERVAL <= 0
+        # disables). Constructed here, STARTED after the socket bind.
+        self.maintenance = None
+        merge_interval = env_float("THEIA_STORE_MERGE_INTERVAL", 5.0)
+        store_stats = getattr(db, "store_stats", None)
+        if merge_interval > 0 and callable(store_stats) and \
+                callable(getattr(db, "maintenance_tick", None)):
+            try:
+                engine = store_stats().get("engine")
+            except Exception:
+                engine = None
+            if engine == "parts":
+                from ..store import PartMaintenanceLoop
+                self.maintenance = PartMaintenanceLoop(
+                    db, interval=merge_interval)
 
         handler = type("BoundHandler", (ManagerAPIHandler,), {
             "controller": self.controller,
@@ -832,6 +880,7 @@ class TheiaManagerServer:
             "profiles": self.profiles,
             "ingest": self.ingest,
             "retention": self.retention,
+            "maintenance": self.maintenance,
             "auth_token": self.auth_token,
         })
         self.httpd = _TLSCapableServer((address, port), handler)
@@ -862,6 +911,8 @@ class TheiaManagerServer:
             self.repairer.start()
         if self.retention is not None:
             self.retention.start()
+        if self.maintenance is not None:
+            self.maintenance.start()
         self._thread: Optional[threading.Thread] = None
         self._serving = False
 
@@ -886,6 +937,8 @@ class TheiaManagerServer:
             self.repairer.stop()
         if self.retention is not None:
             self.retention.stop()
+        if self.maintenance is not None:
+            self.maintenance.stop()
         self.ingest.close()
         self.controller.shutdown()
         if self._thread:

@@ -2,8 +2,11 @@
 
 from .checkpoint import Checkpointer
 from .flow_store import (FlowDatabase, RetentionLoop, RetentionMonitor,
-                         SnapshotCorruption, Table, read_snapshot,
-                         write_snapshot)
+                         SnapshotCorruption, Table, boundary_from_meta,
+                         read_snapshot, write_snapshot)
+from .parts import (PartMaintenanceLoop, PartsError,
+                    PartsManifestError, PartTable,
+                    default_store_engine)
 from .replicated import (AllReplicasDownError, ReplicaRepairLoop,
                          ReplicatedFlowDatabase)
 from .sharded import (DistributedTable, DistributedView,
@@ -15,8 +18,10 @@ from .wal import (SyncPolicy, WalCorruption, WalError, WriteAheadLog,
 
 __all__ = [
     "AllReplicasDownError", "Checkpointer", "FlowDatabase",
-    "ReplicaRepairLoop", "ReplicatedFlowDatabase",
+    "PartMaintenanceLoop", "PartsError", "PartsManifestError",
+    "PartTable", "ReplicaRepairLoop", "ReplicatedFlowDatabase",
     "RetentionLoop", "RetentionMonitor", "SnapshotCorruption", "Table",
+    "boundary_from_meta", "default_store_engine",
     "DistributedTable", "DistributedView", "ShardedFlowDatabase",
     "MATERIALIZED_VIEWS", "ViewSpec", "ViewTable", "group_reduce", "group_sum",
     "SyncPolicy", "WalCorruption", "WalError", "WriteAheadLog",

@@ -26,7 +26,7 @@ import tempfile
 import threading
 import time
 import zlib
-from typing import Callable, Dict, List, Mapping, Optional, Sequence
+from typing import Callable, Dict, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -83,6 +83,10 @@ _M_RET_ROUNDS = _metrics.counter(
 _M_RET_DELETED = _metrics.counter(
     "theia_retention_rows_deleted_total",
     "Flow rows trimmed by capacity-based retention rounds")
+_M_RET_DEMOTED = _metrics.counter(
+    "theia_retention_bytes_demoted_total",
+    "Resident bytes freed by demoting parts to the cold tier instead "
+    "of deleting rows (parts engine tiered retention)")
 _M_SNAP_FALLBACK = _metrics.counter(
     "theia_snapshot_fallbacks_total",
     "Snapshot loads that failed verification on the primary file and "
@@ -135,6 +139,14 @@ class Table:
         self._adopt_maps: Dict[str, DictionaryMapper] = {
             name: DictionaryMapper(d) for name, d in self.dicts.items()}
         self._adopt_lock = threading.Lock()
+        # Cached per-batch (min, max) of the time column, aligned with
+        # _batches: TTL's min_value() probe runs per insert and the
+        # retention boundary runs per monitor round — both become
+        # O(batches) metadata walks instead of O(rows) column scans.
+        self._time_column: Optional[str] = (
+            "timeInserted" if any(c.name == "timeInserted"
+                                  for c in schema) else None)
+        self._batch_meta: List[Tuple[int, int]] = []
         # Durability hook, installed by FlowDatabase.attach_wal:
         # called as hook(table_name, adopted, apply_fn) so the WAL can
         # journal the store-coded batch BEFORE apply_fn makes it
@@ -207,9 +219,27 @@ class Table:
         nbytes = sum(a.nbytes for a in adopted.columns.values())
         with self._lock:
             self._batches.append(adopted)
+            if self._time_column is not None:
+                a = adopted[self._time_column]
+                self._batch_meta.append((int(a.min()), int(a.max())))
             self.generation += 1
             self.rows_inserted_total += len(adopted)
             self.bytes_inserted_total += nbytes
+
+    def _row_count_locked(self) -> int:
+        """Row count; caller holds self._lock (the sharded facade
+        computes per-shard mask offsets under every shard's lock)."""
+        return sum(len(b) for b in self._batches)
+
+    def _refresh_meta_locked(self) -> None:
+        """Rebuild the per-batch time metadata after a bulk rewrite of
+        _batches (delete paths — already O(kept rows))."""
+        if self._time_column is None:
+            return
+        self._batch_meta = [
+            (int(b[self._time_column].min()),
+             int(b[self._time_column].max()))
+            for b in self._batches]
 
     def insert_rows(self, rows: Sequence[Mapping[str, object]]) -> int:
         if not rows:
@@ -236,6 +266,10 @@ class Table:
             if len(self._batches) == len(batches) and \
                     self._batches[-1] is batches[-1]:
                 self._batches = [merged]
+                if self._time_column is not None:
+                    self._batch_meta = [
+                        (min(m[0] for m in self._batch_meta),
+                         max(m[1] for m in self._batch_meta))]
         return merged
 
     def select(self, start_time: Optional[int] = None,
@@ -282,6 +316,7 @@ class Table:
             return 0
         kept = data.filter(~mask)
         self._batches = [kept] if len(kept) else []
+        self._refresh_meta_locked()
         self.generation += 1
         return int(mask.sum())
 
@@ -290,13 +325,28 @@ class Table:
         """Value-based delete: rows whose `column` decodes into `ids`
         (or does NOT, with invert=True). Safe wherever a positional
         mask is not — replicas and shards hold the same logical rows
-        in different physical orders. Computed under the table lock."""
+        in different physical orders. The ids resolve through the
+        DICTIONARY (string → code, allocation-free lookup) so the
+        match is an integer isin over the codes — the old path
+        materialized the full decoded string column per call.
+        Computed under the table lock (including the id→code
+        resolution: with invert=True, an id whose code is minted by a
+        concurrent insert between resolution and mask would otherwise
+        have its fresh rows deleted as 'unlisted')."""
+        d = self.dicts[column]
         with self._lock:
+            codes = np.asarray(sorted(
+                c for c in (d.lookup(str(s)) for s in ids)
+                if c is not None), np.int32)
             if not self._batches:
                 return 0
             data = (self._batches[0] if len(self._batches) == 1
                     else ColumnarBatch.concat(self._batches))
-            mask = np.isin(data.strings(column), list(ids))
+            if len(codes):
+                mask = np.isin(np.asarray(data[column], np.int32),
+                               codes)
+            else:
+                mask = np.zeros(len(data), bool)
             if invert:
                 mask = ~mask
             return self._delete_where_locked(mask)
@@ -304,32 +354,98 @@ class Table:
     def delete_older_than(self, boundary: int,
                           column: str = "timeInserted") -> int:
         """Atomic `column < boundary` delete (mask computed under the
-        lock, so it cannot race with inserts)."""
+        lock, so it cannot race with inserts). Batches whose cached
+        max is already >= boundary skip the column scan."""
         with self._lock:
             if not self._batches:
                 return 0
+            if column == self._time_column and self._batch_meta and \
+                    min(m[0] for m in self._batch_meta) >= boundary:
+                return 0   # metadata proves nothing is evictable
             data = (self._batches[0] if len(self._batches) == 1
                     else ColumnarBatch.concat(self._batches))
             mask = np.asarray(data[column]) < boundary
             if not mask.any():
                 self._batches = [data]
+                self._refresh_meta_locked()
                 return 0
             kept = data.filter(~mask)
             self._batches = [kept] if len(kept) else []
+            self._refresh_meta_locked()
             self.generation += 1
         return int(mask.sum())
 
     def min_value(self, column: str = "timeInserted") -> Optional[int]:
-        """Min over a column without concatenating (None when empty)."""
+        """Min over a column without concatenating (None when empty).
+        For the time column this is an O(batches) walk over cached
+        per-batch minima — the TTL fast path runs it every insert."""
         with self._lock:
+            if column == self._time_column:
+                return (min(m[0] for m in self._batch_meta)
+                        if self._batch_meta else None)
             batches = list(self._batches)
         mins = [int(b[column].min()) for b in batches if len(b)]
         return min(mins) if mins else None
 
+    def _retention_meta(self) -> List[Tuple[int, int, int, Callable]]:
+        """(min, max, rows, fetch_time_column) per resident batch —
+        the retention monitor's O(parts) boundary substrate."""
+        col = self._time_column
+        if col is None:
+            return []
+        with self._lock:
+            pairs = list(zip(self._batches, self._batch_meta))
+        return [(mn, mx, len(b),
+                 (lambda b=b: np.asarray(b[col])))
+                for b, (mn, mx) in pairs]
+
+    def retention_boundary(self, delete_n: int) -> Optional[int]:
+        """timeInserted value of the delete_n-th oldest row, from
+        per-batch metadata (see boundary_from_meta)."""
+        return boundary_from_meta(self._retention_meta(), delete_n)
+
     def truncate(self) -> None:
         with self._lock:
             self._batches = []
+            self._batch_meta = []
             self.generation += 1
+
+
+def boundary_from_meta(metas: List[Tuple[int, int, int, Callable]],
+                       delete_n: int) -> Optional[int]:
+    """Retention boundary (the timeInserted of the delete_n-th oldest
+    row) from per-part metadata, EXACTLY and without sorting the whole
+    table: sort parts by min time, accumulate row counts until a
+    prefix covers the target rank, then np.partition over the time
+    columns of every part whose min is ≤ that prefix's max. Parts
+    excluded that way hold only values strictly above the prefix max,
+    which already bounds the target from above, so the candidate-set
+    k-th smallest IS the global k-th smallest — the same value the
+    old O(n log n) full-column sort produced, at O(parts log parts)
+    metadata work plus a linear partition over the candidate rows
+    (≈ the delete fraction for in-order ingest).
+
+    `metas` entries are (min, max, rows, fetch) where fetch() lazily
+    materializes that part's time column (only candidates pay)."""
+    if delete_n <= 0 or not metas:
+        return None
+    ordered = sorted(metas, key=lambda m: (m[0], m[1]))
+    cum = 0
+    upper: Optional[int] = None
+    for mn, mx, rows, _ in ordered:
+        cum += rows
+        upper = mx if upper is None else max(upper, mx)
+        if cum >= delete_n:
+            break
+    if cum < delete_n:
+        # delete_n exceeds the metadata's row total (racing deletes):
+        # everything metadata knows about is deletable
+        return int(upper) + 1 if upper is not None else None
+    cols = [np.asarray(fetch()) for mn, _, _, fetch in ordered
+            if mn <= upper]
+    col = cols[0] if len(cols) == 1 else np.concatenate(cols)
+    k = delete_n - 1
+    return int(np.partition(col, k)[k])
 
 
 class RetentionMonitor:
@@ -352,29 +468,55 @@ class RetentionMonitor:
         self.delete_percentage = delete_percentage
         self.skip_rounds = skip_rounds
         self._remaining_skip = 0
+        #: cumulative resident bytes freed by demoting parts to the
+        #: cold tier instead of deleting rows (parts engine only)
+        self.bytes_demoted = 0
 
     def usage(self) -> float:
         return self.db.flows.nbytes / float(self.capacity_bytes)
 
     def tick(self) -> int:
-        """Run one monitor round; returns number of flow rows deleted."""
+        """Run one monitor round; returns number of flow rows deleted.
+
+        Tiered retention (parts engine): over-threshold rounds first
+        DEMOTE the oldest hot parts to the cold (disk) tier — data is
+        preserved, resident bytes fall — and only delete rows when
+        demotion alone cannot reach the threshold (no part directory,
+        or everything already cold). The boundary for the delete comes
+        from part/batch min-max metadata (retention_boundary — O(parts)),
+        not a full-column sort."""
         if self._remaining_skip > 0:
             self._remaining_skip -= 1
             return 0
         if self.usage() <= self.threshold:
             return 0
-        flows = self.db.flows.scan()
+        demote = getattr(self.db, "demote_cold", None)
+        if callable(demote):
+            freed = int(demote(
+                int(self.capacity_bytes * self.threshold)))
+            if freed:
+                self.bytes_demoted += freed
+                _M_RET_DEMOTED.inc(freed)
+                if self.usage() <= self.threshold:
+                    self._remaining_skip = self.skip_rounds
+                    return 0
+        flows = self.db.flows
         n = len(flows)
         if n == 0:
             return 0
         delete_n = int(n * self.delete_percentage)
         if delete_n == 0:
             return 0
-        t = np.sort(np.asarray(flows["timeInserted"]))
         # timeInserted of the latest row to delete (LIMIT 1 OFFSET n-1,
-        # main.go:301-318); delete strictly-older rows like the reference's
-        # `timeInserted < boundary`.
-        boundary = t[delete_n - 1]
+        # main.go:301-318); delete strictly-older rows like the
+        # reference's `timeInserted < boundary`.
+        boundary = None
+        rb = getattr(flows, "retention_boundary", None)
+        if callable(rb):
+            boundary = rb(delete_n)
+        if boundary is None:
+            t = np.asarray(flows.scan()["timeInserted"])
+            boundary = int(np.partition(t, delete_n - 1)[delete_n - 1])
         deleted = self.db.delete_flows_older_than(int(boundary))
         if deleted:
             self._remaining_skip = self.skip_rounds
@@ -470,6 +612,7 @@ class RetentionLoop:
         return {
             "rounds": self.rounds,
             "rowsDeleted": self.rows_deleted,
+            "bytesDemoted": getattr(self.monitor, "bytes_demoted", 0),
             "failures": self.failures,
             "intervalSeconds": self.interval,
             "capacityBytes": self.monitor.capacity_bytes,
@@ -583,8 +726,39 @@ class FlowDatabase:
     insert (the MergeTree merge equivalent).
     """
 
-    def __init__(self, ttl_seconds: Optional[int] = None) -> None:
-        self.flows = Table("flows", FLOW_SCHEMA)
+    def __init__(self, ttl_seconds: Optional[int] = None,
+                 engine: Optional[str] = None,
+                 parts_dir: Optional[str] = None,
+                 parts_config: Optional[Dict[str, object]] = None
+                 ) -> None:
+        from .parts import PartTable, default_store_engine
+        self.engine = (engine or default_store_engine()).strip().lower()
+        if self.engine not in ("flat", "parts"):
+            raise ValueError(
+                f"unknown store engine {self.engine!r} "
+                f"(THEIA_STORE_ENGINE): expected flat|parts")
+        if self.engine == "parts":
+            cfg = dict(parts_config or {})
+            if parts_dir is None and "directory" not in cfg:
+                # env fallback for a directly-constructed single
+                # store; sharded/replicated wrappers resolve the env
+                # themselves and pass per-shard/per-replica subdirs
+                parts_dir = os.environ.get("THEIA_STORE_COLD_DIR") \
+                    or None
+            if parts_dir is not None:
+                cfg.setdefault("directory", parts_dir)
+            self.flows: Table = PartTable("flows", FLOW_SCHEMA, **cfg)
+            # Serializes (flows insert + view fan-out) against the
+            # parts-aware snapshot: the snapshot persists VIEW
+            # aggregates (flat rebuilds them from rows at load), so
+            # the capture must not land between a flows append and
+            # its view apply — a row ≤ the stamp would then be
+            # missing from the recovered views forever.
+            from .wal import _Latch
+            self._ingest_latch: Optional[object] = _Latch()
+        else:
+            self.flows = Table("flows", FLOW_SCHEMA)
+            self._ingest_latch = None
         self.result_tables: Dict[str, Table] = {
             name: Table(name, schema)
             for name, schema in RESULT_TABLE_SCHEMAS}
@@ -617,6 +791,14 @@ class FlowDatabase:
         """Insert a flow batch; fan out to materialized views; evict
         TTL. `dedup=(stream, seq)` journals the producer's batch
         identity with the rows (see Table.insert)."""
+        latch = self._ingest_latch
+        with (latch.read() if latch is not None
+              else contextlib.nullcontext()):
+            return self._insert_flows_inner(batch, now, dedup)
+
+    def _insert_flows_inner(self, batch: ColumnarBatch,
+                            now: Optional[int],
+                            dedup: Optional[tuple]) -> int:
         # fires once per PHYSICAL store: once per replica in a
         # replicated fan-out, once per resync re-insert
         _fire_fault("store.insert", table="flows")
@@ -663,6 +845,35 @@ class FlowDatabase:
     @property
     def bytes_inserted_total(self) -> int:
         return self.flows.bytes_inserted_total
+
+    # -- storage engine ----------------------------------------------------
+
+    def store_stats(self) -> Dict[str, object]:
+        """Engine + tier summary for /healthz `store` and the parts
+        gauges on /metrics."""
+        doc: Dict[str, object] = {
+            "engine": self.engine,
+            "flowRows": len(self.flows),
+            "flowBytes": self.flows.nbytes,
+        }
+        ps = getattr(self.flows, "parts_stats", None)
+        if callable(ps):
+            doc["parts"] = ps()
+        return doc
+
+    def demote_cold(self, target_bytes: int) -> int:
+        """Demote the oldest hot parts to the cold (disk) tier until
+        resident flow bytes fall to `target_bytes` (0 on the flat
+        engine, which has no tiering). The retention monitor's
+        delete-avoidance step."""
+        fn = getattr(self.flows, "demote_oldest", None)
+        return int(fn(target_bytes)) if callable(fn) else 0
+
+    def maintenance_tick(self) -> int:
+        """One background-compaction pass over the flows table (parts
+        engine; 0 merges on flat). Driven by PartMaintenanceLoop."""
+        fn = getattr(self.flows, "maintain", None)
+        return int(fn()) if callable(fn) else 0
 
     # -- write-ahead log ---------------------------------------------------
 
@@ -864,17 +1075,63 @@ class FlowDatabase:
         exact), and returns that stamp — the caller passes it to
         `wal_gc()` once the snapshot is known durable. Partial
         (tables=...) snapshots stamp nothing: they are not recovery
-        points."""
+        points.
+
+        Parts engine with a part directory: the sealed parts SUBSUME
+        the bulk of the snapshot. The npz carries only the memtable
+        rows, result tables, dictionaries, and view aggregates; the
+        sealed parts stay on disk behind a generational manifest
+        published atomically (with a `.prev` fallback pair, lag-one
+        with the npz — the PR-4 GC discipline), so a checkpoint costs
+        O(memtable), not O(table), and recovery is manifest load +
+        WAL tail replay."""
         wal = self._wal
-        if wal is not None and tables is None:
-            with wal.quiesce():
-                stamp = wal.last_lsn
+        flows = self.flows
+        parts_aware = (tables is None
+                       and getattr(flows, "directory", None)
+                       and hasattr(flows, "snapshot_parts_state"))
+        if not parts_aware:
+            if wal is not None and tables is None:
+                with wal.quiesce():
+                    stamp = wal.last_lsn
+                    payload = self._snapshot_payload(tables)
+            else:
+                stamp = None
                 payload = self._snapshot_payload(tables)
-        else:
-            stamp = None
-            payload = self._snapshot_payload(tables)
+            write_snapshot(
+                path, payload, compress=compress,
+                wal_lsns=[stamp] if stamp is not None else None)
+            return stamp
+        # The ingest latch (writer side) excludes in-flight
+        # insert_flows across BOTH legs (flows append + view apply);
+        # the WAL quiesce additionally freezes result-table appends so
+        # the stamp partitions every table's records exactly.
+        with contextlib.ExitStack() as stack:
+            if self._ingest_latch is not None:
+                stack.enter_context(self._ingest_latch.write())
+            if wal is not None:
+                stack.enter_context(wal.quiesce())
+            stamp = wal.last_lsn if wal is not None else None
+            entries, payload = flows.snapshot_parts_state()
+            for table in self.result_tables.values():
+                data = table.scan()
+                for col in table.schema:
+                    payload[f"{table.name}/{col.name}"] = data[col.name]
+            for table in (flows, *self.result_tables.values()):
+                for name, d in table.dicts.items():
+                    payload[f"{table.name}/__dict__/{name}"] = \
+                        np.asarray(d._strings, dtype=object)
+            for name, view in self.views.items():
+                keys, values = view._merged()
+                payload[f"__view__/{name}/keys"] = keys
+                payload[f"__view__/{name}/values"] = values
+        gen = flows.publish_manifest(entries, stamp)
+        payload["__parts__/generation"] = np.asarray(gen, np.int64)
+        payload["__parts__/dir"] = np.asarray(
+            os.path.abspath(flows.directory), dtype=object)
         write_snapshot(path, payload, compress=compress,
                        wal_lsns=[stamp] if stamp is not None else None)
+        flows.gc_part_files()
         return stamp
 
     def _snapshot_payload(self, tables: Optional[Sequence[str]] = None
@@ -894,21 +1151,96 @@ class FlowDatabase:
     @classmethod
     def load(cls, path: str,
              ttl_seconds: Optional[int] = None,
-             build_views: bool = True) -> "FlowDatabase":
+             build_views: bool = True,
+             engine: Optional[str] = None,
+             parts_dir: Optional[str] = None,
+             parts_config: Optional[Dict[str, object]] = None
+             ) -> "FlowDatabase":
         """Load a persisted database, migrating older schema versions
         up to current first (the reference's schema-management init
         container runs before the server the same way).
 
         build_views=False skips materialized-view fan-out — for callers
         that immediately re-insert the rows elsewhere (sharded load)
-        and would otherwise pay the O(rows) view build twice."""
-        from .migration import migrate
-        db = cls(ttl_seconds=None)
+        and would otherwise pay the O(rows) view build twice.
+
+        A parts-aware snapshot (engine=parts with a part directory)
+        loads as: manifest adoption (parts register LAZILY — metadata
+        resident, columns decoded on first touch) + memtable rows +
+        restored view aggregates. An unloadable manifest generation
+        falls back — loudly, with the snapshot-fallback metric — to
+        the `<path>.prev` snapshot and ITS manifest generation, which
+        the lag-one part/WAL GC keeps recoverable."""
+        from .parts import PartsManifestError
         payload = read_snapshot(path)
+        try:
+            return cls._from_payload(payload, ttl_seconds, build_views,
+                                     engine, parts_dir, parts_config)
+        except PartsManifestError as e:
+            prev = path + ".prev"
+            if not os.path.exists(prev):
+                raise
+            _logger.error(
+                "snapshot %s pairs with an unloadable part manifest "
+                "(%s) — falling back to previous snapshot %s",
+                path, e, prev)
+            _M_SNAP_FALLBACK.inc()
+            payload = read_snapshot(prev)
+            return cls._from_payload(payload, ttl_seconds, build_views,
+                                     engine, parts_dir, parts_config)
+
+    @classmethod
+    def _from_payload(cls, payload: Dict[str, np.ndarray],
+                      ttl_seconds: Optional[int],
+                      build_views: bool,
+                      engine: Optional[str],
+                      parts_dir: Optional[str],
+                      parts_config: Optional[Dict[str, object]]
+                      ) -> "FlowDatabase":
+        from .migration import migrate
+        from .parts import PartTable
+        parts_gen = payload.get("__parts__/generation")
+        if parts_gen is not None and parts_dir is None and \
+                "__parts__/dir" in payload:
+            # The snapshot records the EXACT directory its manifest
+            # generation lives in — a replica/shard subdir, not the
+            # THEIA_STORE_COLD_DIR base — so the recorded path beats
+            # the env var here (a replicated restart with the env set
+            # would otherwise look for manifest.json one level up and
+            # fail). Callers relocating data pass parts_dir
+            # explicitly.
+            parts_dir = str(np.asarray(
+                payload["__parts__/dir"]).item())
+        if parts_gen is not None and engine is None and \
+                not os.environ.get("THEIA_STORE_ENGINE"):
+            # a parts-aware snapshot self-describes its engine when
+            # neither the caller nor the environment says otherwise
+            engine = "parts"
+        db = cls(ttl_seconds=None, engine=engine, parts_dir=parts_dir,
+                 parts_config=parts_config)
         if WAL_LSNS_KEY in payload:
             db._snapshot_lsns = [
                 int(v) for v in np.asarray(payload[WAL_LSNS_KEY])]
         migrate(payload)
+        if parts_gen is not None and \
+                not isinstance(db.flows, PartTable):
+            # Cross-engine load (parts snapshot → flat store, the
+            # engine-flip escape hatch): materialize through a donor
+            # parts database, then feed the rows down the flat path.
+            donor = cls._from_payload(payload, None, False, "parts",
+                                      parts_dir, parts_config)
+            flows = donor.flows.scan()
+            if len(flows):
+                if build_views:
+                    db.insert_flows(flows)
+                else:
+                    db.flows.insert(flows)
+            for name, src in donor.result_tables.items():
+                data = src.scan()
+                if len(data):
+                    db.result_tables[name].insert(data)
+            db.ttl_seconds = ttl_seconds
+            return db
         for table in (db.flows, *db.result_tables.values()):
             cols: Dict[str, np.ndarray] = {}
             for name, d in table.dicts.items():
@@ -920,6 +1252,20 @@ class FlowDatabase:
                 key = f"{table.name}/{col.name}"
                 if key in payload:
                     cols[col.name] = payload[key]
+            if table is db.flows and parts_gen is not None:
+                # manifest parts first (insertion order), then the
+                # npz-carried memtable tail — no seal, no view work
+                # (views restore below); raises PartsManifestError
+                # for the caller's .prev fallback
+                db.flows.load_manifest(int(np.asarray(parts_gen)))
+                if cols and len(next(iter(cols.values()))):
+                    n = len(next(iter(cols.values())))
+                    batch = ColumnarBatch(
+                        {c.name: cols.get(c.name, np.zeros(
+                            n, c.host_dtype)) for c in table.schema},
+                        table.dicts)
+                    db.flows._append_adopted(batch, seal=False)
+                continue
             if cols and len(next(iter(cols.values()))):
                 batch = ColumnarBatch(
                     {c.name: cols.get(c.name, np.zeros(
@@ -929,5 +1275,21 @@ class FlowDatabase:
                     db.insert_flows(batch)
                 else:
                     table.insert(batch)
+        if parts_gen is not None and build_views:
+            restored = 0
+            for name, view in db.views.items():
+                kk = f"__view__/{name}/keys"
+                vk = f"__view__/{name}/values"
+                if kk in payload and vk in payload:
+                    view.restore(payload[kk], payload[vk])
+                    restored += 1
+            if restored < len(db.views) and len(db.flows):
+                # older/partial parts snapshot without view payloads:
+                # rebuild the aggregates from the rows (the flat-load
+                # discipline — decodes every part once)
+                data = db.flows.scan()
+                for view in db.views.values():
+                    view.truncate()
+                    view.apply_insert_block(data)
         db.ttl_seconds = ttl_seconds
         return db

@@ -37,6 +37,7 @@ set_replica_down are operator intent and are never re-admitted by it.
 from __future__ import annotations
 
 import contextlib
+import itertools
 import os
 import threading
 import time
@@ -163,8 +164,22 @@ class ReplicatedFlowDatabase:
                  ttl_seconds: Optional[int] = None) -> None:
         if replicas < 1:
             raise ValueError("replicas must be >= 1")
-        make = factory or (
-            lambda: FlowDatabase(ttl_seconds=ttl_seconds))
+        if factory is None:
+            # default factory resolves THEIA_STORE_COLD_DIR ONCE and
+            # gives every replica its own subdirectory — per-replica
+            # env resolution would share one part directory, and the
+            # active replica's save-time GC would delete its peers'
+            # cold-tier files
+            base = os.environ.get("THEIA_STORE_COLD_DIR") or None
+            counter = itertools.count()
+
+            def factory():
+                i = next(counter)
+                return FlowDatabase(
+                    ttl_seconds=ttl_seconds,
+                    parts_dir=(os.path.join(base, f"replica-{i:03d}")
+                               if base else ""))
+        make = factory
         self.replicas: List = [make() for _ in range(replicas)]
         self._down: set = set()
         #: auto-quarantined replica index → {reason, since,
@@ -532,6 +547,17 @@ class ReplicatedFlowDatabase:
         from .flow_store import RetentionMonitor
         return RetentionMonitor(self, capacity_bytes, **kw)
 
+    def demote_cold(self, target_bytes: int) -> int:
+        """Tiered retention must reach EVERY live replica (each holds
+        a full copy; __getattr__ would demote only the active one).
+        Returns the max freed — replicas are copies, so summing would
+        double-count the logical bytes."""
+        return max((r.demote_cold(target_bytes)
+                    for r in self.live()), default=0)
+
+    def maintenance_tick(self) -> int:
+        return sum(r.maintenance_tick() for r in self.live())
+
     def __getattr__(self, name):
         # flows / views / ttl_seconds / save / shards / ... — served by
         # the active replica. (Direct writes through these bypass
@@ -550,7 +576,11 @@ class ReplicatedFlowDatabase:
         FlowDatabase.load / ShardedFlowDatabase.load)."""
         db = cls(replicas=replicas, ttl_seconds=ttl_seconds, **kw)
         saved_ttls = [_suspend_ttl(r) for r in db.replicas]
-        single = FlowDatabase.load(path, build_views=False)
+        # flat temp carrier (parts-aware snapshots decode through the
+        # cross-engine donor path; a parts carrier would seal
+        # transient files beside the replicas')
+        single = FlowDatabase.load(path, build_views=False,
+                                   engine="flat")
         for r in db.replicas:
             # every replica starts at the snapshot's WAL stamp, so a
             # later attach_wal replays only records above it

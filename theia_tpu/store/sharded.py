@@ -122,8 +122,7 @@ class DistributedTable:
         with contextlib.ExitStack() as stack:
             for t in self.tables:
                 stack.enter_context(t._lock)
-            lengths = [sum(len(b) for b in t._batches)
-                       for t in self.tables]
+            lengths = [t._row_count_locked() for t in self.tables]
             if len(mask) != sum(lengths):
                 raise ValueError(
                     f"mask length {len(mask)} != table length "
@@ -149,6 +148,19 @@ class DistributedTable:
         mins = [m for m in (t.min_value(column) for t in self.tables)
                 if m is not None]
         return min(mins) if mins else None
+
+    def retention_boundary(self, delete_n: int) -> Optional[int]:
+        """Cluster-wide boundary from every shard's part/batch
+        metadata (the reference monitor runs its boundary query over
+        the Distributed table the same way)."""
+        from .flow_store import boundary_from_meta
+        metas = []
+        for t in self.tables:
+            rm = getattr(t, "_retention_meta", None)
+            if not callable(rm):
+                return None
+            metas.extend(rm())
+        return boundary_from_meta(metas, delete_n)
 
     def truncate(self) -> None:
         for t in self.tables:
@@ -198,12 +210,25 @@ class ShardedFlowDatabase:
 
     def __init__(self, n_shards: int = 2,
                  ttl_seconds: Optional[int] = None,
-                 seed: int = 0) -> None:
+                 seed: int = 0,
+                 engine: Optional[str] = None,
+                 parts_dir: Optional[str] = None,
+                 parts_config: Optional[Dict[str, object]] = None
+                 ) -> None:
         if n_shards < 1:
             raise ValueError("n_shards must be >= 1")
+        if parts_dir is None:
+            # resolve the env HERE so every shard gets its own
+            # subdirectory — per-shard resolution would make all
+            # shards share one part directory (and one GC)
+            parts_dir = os.environ.get("THEIA_STORE_COLD_DIR") or None
         self.shards: List[FlowDatabase] = [
-            FlowDatabase(ttl_seconds=ttl_seconds)
-            for _ in range(n_shards)]
+            FlowDatabase(
+                ttl_seconds=ttl_seconds, engine=engine,
+                parts_dir=(os.path.join(parts_dir, f"shard-{i:03d}")
+                           if parts_dir else ""),
+                parts_config=parts_config)
+            for i in range(n_shards)]
         # One Generator per table: each DistributedTable serializes its
         # own rand() stream under its own lock; sharing one Generator
         # across tables would race (Generators are not thread-safe).
@@ -426,6 +451,33 @@ class ShardedFlowDatabase:
         # (the reference monitor runs the boundary query cluster-wide).
         return RetentionMonitor(self, capacity_bytes, **kw)
 
+    def demote_cold(self, target_bytes: int) -> int:
+        """Tiered retention across shards: each shard demotes toward
+        an equal split of the resident-byte target."""
+        per = max(0, int(target_bytes) // self.n_shards)
+        return sum(s.demote_cold(per) for s in self.shards)
+
+    def maintenance_tick(self) -> int:
+        return sum(s.maintenance_tick() for s in self.shards)
+
+    def store_stats(self) -> Dict[str, object]:
+        """Aggregated engine/tier summary across shards."""
+        per = [s.store_stats() for s in self.shards]
+        doc: Dict[str, object] = {
+            "engine": per[0]["engine"],
+            "shards": len(per),
+            "flowRows": sum(int(p["flowRows"]) for p in per),
+            "flowBytes": sum(int(p["flowBytes"]) for p in per),
+        }
+        if any("parts" in p for p in per):
+            keys = ("count", "hot", "cold", "hotBytes", "coldBytes",
+                    "rows", "memtableRows", "memtableBytes", "sealed",
+                    "merges", "demoted")
+            agg = {k: sum(int(p["parts"][k]) for p in per
+                          if "parts" in p) for k in keys}
+            doc["parts"] = agg
+        return doc
+
     # -- persistence ------------------------------------------------------
 
     def save(self, path: str, tables=None, compress: bool = True
@@ -451,8 +503,11 @@ class ShardedFlowDatabase:
             for name, src in self.result_tables.items():
                 datas[name] = src.scan()
         # merge + serialize OUTSIDE the quiesce window — only the
-        # scans need the consistent point
-        merged = FlowDatabase()
+        # scans need the consistent point. The merged carrier is
+        # explicitly FLAT: a parts-engine carrier would write
+        # transient part files beside the live shards' for no benefit
+        # (the sharded snapshot is a wholesale logical backup).
+        merged = FlowDatabase(engine="flat")
         if len(datas["flows"]):
             merged.flows.insert(datas["flows"])
         for name in self.result_tables:
@@ -465,13 +520,24 @@ class ShardedFlowDatabase:
     @classmethod
     def load(cls, path: str, n_shards: int = 2,
              ttl_seconds: Optional[int] = None,
-             seed: int = 0) -> "ShardedFlowDatabase":
-        single = FlowDatabase.load(path, build_views=False)
+             seed: int = 0,
+             engine: Optional[str] = None,
+             parts_dir: Optional[str] = None,
+             parts_config: Optional[Dict[str, object]] = None
+             ) -> "ShardedFlowDatabase":
+        # The temp carrier is flat: a parts-engine carrier would seal
+        # transient part files it immediately discards (a parts-aware
+        # snapshot still loads — the cross-engine donor path decodes
+        # it).
+        single = FlowDatabase.load(path, build_views=False,
+                                   engine="flat")
         # Defer TTL until every row is back in, exactly like
         # FlowDatabase.load (flow_store.py) — otherwise the re-insert
         # itself evicts persisted rows, at a routing-dependent boundary
         # per shard.
-        db = cls(n_shards=n_shards, ttl_seconds=None, seed=seed)
+        db = cls(n_shards=n_shards, ttl_seconds=None, seed=seed,
+                 engine=engine, parts_dir=parts_dir,
+                 parts_config=parts_config)
         db._snapshot_lsns = list(single._snapshot_lsns)
         flows = single.flows.scan()
         if len(flows):

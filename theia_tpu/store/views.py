@@ -231,6 +231,19 @@ class ViewTable:
         return materialize_view_batch(self.spec, keys, values,
                                       self.dicts)
 
+    def restore(self, keys: np.ndarray, values: np.ndarray) -> None:
+        """Install persisted (keys, values) aggregates wholesale — the
+        parts-aware snapshot saves views instead of rebuilding them
+        from rows at load (the flat-load discipline would force every
+        lazy part to decode). The arrays come from a `_merged()`
+        capture, so the single part is exact."""
+        with self._lock:
+            self._parts = [(np.asarray(keys, np.int64).reshape(
+                                -1, len(self.spec.key_columns)),
+                            np.asarray(values, np.int64).reshape(
+                                -1, len(self.spec.sum_columns)),
+                            True)]
+
     def delete_older_than(self, boundary: int) -> int:
         """Drop view rows with timeInserted < boundary (retention trim
         deletes from MVs too, clickhouse-monitor/main.go:284-293).
