@@ -1083,6 +1083,156 @@ def run_benchmarks() -> dict:
     except Exception as e:
         print(f"parts bench skipped: {e}", file=sys.stderr)
 
+    # Vectorized query engine over column parts (PR 8,
+    # theia_tpu/query/): filtered group-by aggregation running
+    # part-NATIVE (pruned, encoded-space filters, late-materializing
+    # group keys) vs the decode-then-aggregate baseline (scan() to
+    # table code space + the reference executor — what a job would
+    # do). The query_parity_ok gate (parts engine == flat engine ==
+    # pure-numpy reference, bit for bit) runs before ANY timed
+    # window; legs: group-sum rows/s vs baseline, pruned-window
+    # speedup, cold-tier scan rate (with a no-promotion check), and
+    # cache-hit latency. THEIA_BENCH_FAST runs a one-window smoke.
+    query_bench: dict = {}
+    query_parity_ok = None
+    try:
+        import shutil
+        import tempfile
+
+        from theia_tpu.query import (QueryEngine, parse_plan,
+                                     reference_execute)
+        from theia_tpu.schema import ColumnarBatch as _QCB
+        from theia_tpu.store import FlowDatabase as _QDb
+
+        fastq = os.environ.get("THEIA_BENCH_FAST") == "1"
+        nq_windows = 1 if fastq else 12
+        baseq = generate_flows(SynthConfig(n_series=2000,
+                                           points_per_series=30))
+
+        def _q_shifted(i):
+            cols = dict(baseq.columns)
+            for c in ("timeInserted", "flowStartSeconds",
+                      "flowEndSeconds"):
+                cols[c] = baseq[c] + i * 3600
+            return _QCB(cols, baseq.dicts)
+
+        qwindows = [_q_shifted(i) for i in range(nq_windows)]
+        qflat = _QDb(engine="flat")
+        qparts = _QDb(engine="parts")
+        for w in qwindows:
+            qflat.insert_flows(w)
+            qparts.insert_flows(w)
+        qparts.flows.seal()
+        n_qrows = len(qflat.flows)
+        q_lo = int(qwindows[0]["flowStartSeconds"].min())
+        groupsum = parse_plan({
+            "groupBy": "sourceIP",
+            "aggregates": ["sum:octetDeltaCount", "count"], "k": 0})
+        windowed = parse_plan({
+            "groupBy": "sourceIP,destinationIP",
+            "aggregates": ["sum:octetDeltaCount", "mean:throughput"],
+            "start": q_lo, "end": q_lo + 1800,
+            "filters": [{"column": "destinationTransportPort",
+                         "op": ">=", "value": 1}], "k": 10})
+        eng_p = QueryEngine(qparts)
+        eng_f = QueryEngine(qflat)
+
+        # parity gate — before any timed window
+        query_parity_ok = True
+        for qp in (groupsum, windowed):
+            rp = eng_p.execute(qp, use_cache=False)
+            rf = eng_f.execute(qp, use_cache=False)
+            rref, gref, _ = reference_execute(
+                qp, qflat.flows.scan(), qflat.flows.dicts)
+            if not (rp["rows"] == rf["rows"] == rref
+                    and rp["groupCount"] == rf["groupCount"] == gref):
+                query_parity_ok = False
+        print("query engine parity: "
+              + ("ok" if query_parity_ok else "MISMATCH"),
+              file=sys.stderr)
+        if query_parity_ok:
+            # group-sum through the engine vs decode-then-aggregate
+            iters = 1 if fastq else 3
+            best_q = best_base = float("inf")
+            for _ in range(iters):
+                tq = time.perf_counter()
+                eng_p.execute(groupsum, use_cache=False)
+                best_q = min(best_q, time.perf_counter() - tq)
+                tq = time.perf_counter()
+                reference_execute(groupsum, qparts.flows.scan(),
+                                  qparts.flows.dicts)
+                best_base = min(best_base,
+                                time.perf_counter() - tq)
+            query_bench["query_groupsum_rows_per_sec"] = round(
+                n_qrows / best_q)
+            query_bench["query_baseline_rows_per_sec"] = round(
+                n_qrows / best_base)
+            query_bench["query_groupsum_vs_baseline"] = round(
+                best_base / best_q, 1)
+
+            # pruned narrow window vs the same query decoded
+            best_qw = best_bw = float("inf")
+            for _ in range(iters):
+                tq = time.perf_counter()
+                eng_p.execute(windowed, use_cache=False)
+                best_qw = min(best_qw, time.perf_counter() - tq)
+                tq = time.perf_counter()
+                reference_execute(windowed, qparts.flows.scan(),
+                                  qparts.flows.dicts)
+                best_bw = min(best_bw, time.perf_counter() - tq)
+            if best_qw > 0:
+                query_bench["query_pruned_window_speedup"] = round(
+                    best_bw / best_qw, 1)
+
+            # cold tier: demote everything, re-run group-sum through
+            # the column-subset streaming path; the tier must not move
+            tmpq = tempfile.mkdtemp(prefix="theia-query-bench-")
+            try:
+                qcold = _QDb(engine="parts",
+                             parts_dir=os.path.join(tmpq, "parts"))
+                for w in qwindows:
+                    qcold.insert_flows(w)
+                qcold.flows.seal()
+                qcold.flows.demote_oldest(0)
+                before_hot = qcold.flows.parts_stats()["hotBytes"]
+                eng_c = QueryEngine(qcold)
+                rc = eng_c.execute(groupsum, use_cache=False)
+                best_c = float("inf")
+                for _ in range(iters):
+                    tq = time.perf_counter()
+                    eng_c.execute(groupsum, use_cache=False)
+                    best_c = min(best_c, time.perf_counter() - tq)
+                after_hot = qcold.flows.parts_stats()["hotBytes"]
+                query_bench["query_cold_tier_rows_per_sec"] = round(
+                    n_qrows / best_c)
+                query_bench["query_cold_no_promotion_ok"] = (
+                    before_hot == after_hot == 0)
+                if rc["rows"] != eng_p.execute(
+                        groupsum, use_cache=False)["rows"]:
+                    query_parity_ok = False
+            finally:
+                shutil.rmtree(tmpq, ignore_errors=True)
+
+            # cache hit latency (same plan, unchanged fingerprint)
+            eng_p.cache.clear()
+            eng_p.execute(groupsum)
+            hits = []
+            for _ in range(5 if fastq else 20):
+                tq = time.perf_counter()
+                out = eng_p.execute(groupsum)
+                hits.append(time.perf_counter() - tq)
+                assert out["cache"] == "hit"
+            query_bench["query_cache_hit_ms"] = round(
+                sorted(hits)[len(hits) // 2] * 1e3, 3)
+            print("query engine: " + ", ".join(
+                f"{k.replace('query_', '')} {v:,}"
+                if isinstance(v, (int, float)) else f"{k} {v}"
+                for k, v in query_bench.items()), file=sys.stderr)
+    except Exception as e:
+        import traceback
+        print(f"query bench skipped: {e}", file=sys.stderr)
+        traceback.print_exc(file=sys.stderr)
+
     # Overload behavior through a REAL manager (ephemeral port), two
     # phases: (A) flat-out exactly-once producers with admission
     # unlimited measure the HTTP-path capacity of this host; (B) the
@@ -1294,6 +1444,10 @@ def run_benchmarks() -> dict:
         result["parts_parity_ok"] = parts_parity_ok
     if parts_bench:
         result.update(parts_bench)
+    if query_parity_ok is not None:
+        result["query_parity_ok"] = query_parity_ok
+    if query_bench:
+        result.update(query_bench)
     if overload:
         result.update(overload)
     if fused_parity_ok is not None:

@@ -606,7 +606,11 @@ def test_unpublished_table_maintenance_gcs_files(tmp_path):
         sh.insert_flows(_batch(n_series=10, seed=i))
     sh.maintenance_tick()           # merges retire pre-merge files
     sh.delete_flows_older_than(10**12)   # retire everything else
-    sh.maintenance_tick()           # unpublished GC collects
+    # the unpublished GC is TWO-PHASE: pass 1 marks unreferenced
+    # files, pass 2 unlinks them — in-flight readers that snapshotted
+    # the part list get one maintenance interval of grace
+    sh.maintenance_tick()
+    sh.maintenance_tick()
     leftovers = [n for d in os.listdir(tmp_path)
                  for n in os.listdir(os.path.join(tmp_path, d))
                  if n.endswith(".tprt")]

@@ -1,0 +1,773 @@
+"""Vectorized query engine (theia_tpu/query/).
+
+The load-bearing contract is the PARITY ORACLE: for any plan, the
+parts engine (pruned, encoded-space, late-materializing, possibly
+jax-kerneled), the flat engine (reference executor over a scan), and
+the standalone pure-numpy reference must answer BIT-IDENTICALLY —
+through seals, merges, deletes, TTL, demotion to the cold tier, and
+cache hits. Plus the machinery around it: plan validation, min/max +
+dictionary-code pruning, cold parts streaming without promotion,
+column-subset part-file decode, the cold small-part merge pass, the
+fingerprint-keyed result cache, the admission ladder's query rung,
+and the /query HTTP surface.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from theia_tpu.data.synth import SynthConfig, generate_flows
+from theia_tpu.query import (PlanError, QueryEngine, parse_plan,
+                             plan_from_params, reference_execute)
+from theia_tpu.query import kernels as qkernels
+from theia_tpu.schema import FLOW_SCHEMA, ColumnarBatch
+from theia_tpu.store import FlowDatabase, ShardedFlowDatabase
+from theia_tpu.store.parts import read_part_file
+
+pytestmark = pytest.mark.query
+
+
+def _batch(n_series=20, points=10, seed=0, shift=0):
+    b = generate_flows(SynthConfig(n_series=n_series,
+                                   points_per_series=points,
+                                   seed=seed))
+    if shift:
+        for col in ("timeInserted", "flowStartSeconds",
+                    "flowEndSeconds"):
+            b.columns[col] = b[col] + shift
+    return b
+
+
+def _pair(tmp_path=None, memtable_rows=128, ttl_seconds=None, **cfg):
+    parts_cfg = {"memtable_rows": memtable_rows, **cfg}
+    flat = FlowDatabase(engine="flat", ttl_seconds=ttl_seconds)
+    parts = FlowDatabase(
+        engine="parts", ttl_seconds=ttl_seconds,
+        parts_dir=str(tmp_path / "parts") if tmp_path else None,
+        parts_config=parts_cfg)
+    return flat, parts
+
+
+def _assert_same_answer(plan, flat, parts, check_reference=True):
+    """The parity oracle: parts engine == flat engine == pure-numpy
+    reference, bit for bit (ints compare exactly; means come from the
+    same int sums + one float64 division, so == is exact too)."""
+    rf = QueryEngine(flat).execute(plan, use_cache=False)
+    rp = QueryEngine(parts).execute(plan, use_cache=False)
+    assert rf["rows"] == rp["rows"], (rf["rows"][:3], rp["rows"][:3])
+    assert rf["groupCount"] == rp["groupCount"]
+    if check_reference:
+        rows_ref, groups_ref, _ = reference_execute(
+            plan, flat.flows.scan(), flat.flows.dicts)
+        assert rows_ref == rf["rows"]
+        assert groups_ref == rf["groupCount"]
+    return rp
+
+
+# -- plan parsing ---------------------------------------------------------
+
+
+def test_plan_validation_errors():
+    with pytest.raises(PlanError):
+        parse_plan({"groupBy": "noSuchColumn"})
+    with pytest.raises(PlanError):
+        parse_plan({"agg": "sum:noSuchColumn"})
+    with pytest.raises(PlanError):
+        parse_plan({"agg": "median:throughput"})
+    with pytest.raises(PlanError):
+        parse_plan({"agg": "sum:sourceIP"})   # string aggregation
+    with pytest.raises(PlanError):
+        parse_plan({"filters": [{"column": "sourceIP", "op": ">=",
+                                 "value": "x"}]})
+    with pytest.raises(PlanError):
+        parse_plan({"filters": [{"column": "throughput", "op": "in",
+                                 "value": []}]})
+    with pytest.raises(PlanError):
+        parse_plan({"agg": "count", "orderBy": "sum(throughput)"})
+    with pytest.raises(PlanError):
+        parse_plan({"k": -1})
+    with pytest.raises(PlanError):
+        parse_plan({"groupBy": "sourceIP,sourceIP"})
+    # a string column cannot anchor the time window (it would die
+    # inside the encoded-part evaluator as a 500 instead of a 400)
+    with pytest.raises(PlanError):
+        parse_plan({"timeColumn": "sourceIP", "start": 5})
+    with pytest.raises(PlanError):
+        parse_plan({"endColumn": "tcpState", "end": 5})
+
+
+def test_plan_normalization_is_spelling_invariant():
+    a = parse_plan({
+        "groupBy": ["sourceIP"],
+        "aggregates": [{"op": "sum", "column": "throughput"}],
+        "filters": [
+            {"column": "destinationTransportPort", "op": ">=",
+             "value": 10},
+            {"column": "sourceIP", "op": "=", "value": "a"}]})
+    b = parse_plan({
+        "groupBy": "sourceIP",
+        "agg": "sum:throughput",
+        "filters": [
+            {"column": "sourceIP", "op": "eq", "value": "a"},
+            {"column": "destinationTransportPort", "op": "ge",
+             "value": "10"}]})
+    assert a.normalized() == b.normalized()
+    assert a.fingerprint() == b.fingerprint()
+
+
+def test_plan_from_get_params_matches_post_body():
+    via_get = plan_from_params({
+        "group_by": "sourceIP,destinationIP",
+        "agg": "sum:octetDeltaCount,count",
+        "where": "destinationTransportPort:ge:100;sourceIP:eq:10.0.0.1",
+        "start": "5", "end": "99", "k": "7"})
+    via_post = parse_plan({
+        "groupBy": ["sourceIP", "destinationIP"],
+        "aggregates": ["sum:octetDeltaCount", "count"],
+        "filters": [
+            {"column": "destinationTransportPort", "op": ">=",
+             "value": 100},
+            {"column": "sourceIP", "op": "=", "value": "10.0.0.1"}],
+        "start": 5, "end": 99, "k": 7})
+    assert via_get.normalized() == via_post.normalized()
+
+
+def test_plan_columns_touched():
+    plan = parse_plan({"groupBy": "sourceIP",
+                       "agg": "sum:octetDeltaCount",
+                       "filters": [{"column": "tcpState", "op": "=",
+                                    "value": "ESTABLISHED"}],
+                       "start": 1, "end": 2})
+    touched = plan.columns_touched()
+    assert set(touched) == {"sourceIP", "octetDeltaCount", "tcpState",
+                            "flowStartSeconds", "flowEndSeconds"}
+
+
+# -- engine parity --------------------------------------------------------
+
+
+def test_groupby_parity_flat_parts_reference():
+    flat, parts = _pair()
+    for i in range(4):
+        b = _batch(seed=i)
+        flat.insert_flows(b)
+        parts.insert_flows(b)
+    plan = parse_plan({
+        "groupBy": "sourceIP,destinationIP",
+        "aggregates": ["sum:octetDeltaCount", "count",
+                       "mean:throughput", "min:packetDeltaCount",
+                       "max:packetDeltaCount"],
+        "k": 0})
+    out = _assert_same_answer(plan, flat, parts)
+    assert out["engine"] == "parts"
+    assert out["groupCount"] > 1
+
+
+def test_global_aggregate_and_empty_window():
+    flat, parts = _pair()
+    b = _batch()
+    flat.insert_flows(b)
+    parts.insert_flows(b)
+    # global (no group-by)
+    plan = parse_plan({"agg": ["count", "sum:octetDeltaCount",
+                               "mean:throughput"]})
+    out = _assert_same_answer(plan, flat, parts)
+    assert out["rows"][0]["count"] == len(b)
+    # empty window: one zero row globally, no rows grouped
+    empty = parse_plan({"agg": "count", "start": 0, "end": 1})
+    out = _assert_same_answer(empty, flat, parts)
+    assert out["rows"] == [{"count": 0}]
+    gempty = parse_plan({"groupBy": "sourceIP", "agg": "count",
+                         "start": 0, "end": 1})
+    out = _assert_same_answer(gempty, flat, parts)
+    assert out["rows"] == []
+
+
+def test_string_filters_eq_ne_in_and_unknown_value():
+    flat, parts = _pair()
+    for i in range(3):
+        b = _batch(seed=i)
+        flat.insert_flows(b)
+        parts.insert_flows(b)
+    parts.flows.seal()
+    some_ip = flat.flows.dicts["sourceIP"].decode_one(
+        int(flat.flows.scan()["sourceIP"][0]))
+    for filters in (
+            [{"column": "sourceIP", "op": "=", "value": some_ip}],
+            [{"column": "sourceIP", "op": "!=", "value": some_ip}],
+            [{"column": "sourceIP", "op": "in",
+              "value": [some_ip, "10.99.99.99"]}],
+            # unknown value: eq matches nothing, ne matches everything
+            [{"column": "sourceIP", "op": "=", "value": "nope"}],
+            [{"column": "sourceIP", "op": "!=", "value": "nope"}]):
+        plan = parse_plan({"groupBy": "destinationIP", "agg": "count",
+                           "filters": filters, "k": 0})
+        _assert_same_answer(plan, flat, parts)
+
+
+def test_numeric_filters_encoded_space_thresholds():
+    """Width-reduced compare: thresholds inside, below, and above the
+    narrow stored range — the clamp logic must agree with the decoded
+    reference bit for bit."""
+    flat, parts = _pair()
+    for i in range(2):
+        b = _batch(seed=i)
+        flat.insert_flows(b)
+        parts.insert_flows(b)
+    parts.flows.seal()
+    port = int(flat.flows.scan()["destinationTransportPort"][0])
+    cases = [
+        [{"column": "destinationTransportPort", "op": ">=",
+          "value": port}],
+        [{"column": "destinationTransportPort", "op": "<",
+          "value": port}],
+        [{"column": "destinationTransportPort", "op": "=",
+          "value": port}],
+        [{"column": "destinationTransportPort", "op": "!=",
+          "value": port}],
+        [{"column": "destinationTransportPort", "op": "in",
+          "value": [port, 1, 10 ** 12]}],
+        # far outside any narrow range, both directions
+        [{"column": "octetDeltaCount", "op": ">=", "value": -10 ** 15}],
+        [{"column": "octetDeltaCount", "op": ">=", "value": 10 ** 15}],
+        [{"column": "octetDeltaCount", "op": "<", "value": -10 ** 15}],
+        [{"column": "octetDeltaCount", "op": "<", "value": 10 ** 15}],
+        [{"column": "octetDeltaCount", "op": "=", "value": 10 ** 15}],
+    ]
+    for filters in cases:
+        plan = parse_plan({"groupBy": "sourceIP", "agg": "count",
+                           "filters": filters, "k": 0})
+        _assert_same_answer(plan, flat, parts)
+
+
+def test_numeric_groupby_widens_with_base():
+    flat, parts = _pair()
+    for i in range(2):
+        b = _batch(seed=i)
+        flat.insert_flows(b)
+        parts.insert_flows(b)
+    parts.flows.seal()
+    plan = parse_plan({"groupBy": "destinationTransportPort,sourceIP",
+                       "agg": ["count", "sum:octetDeltaCount"],
+                       "k": 0})
+    _assert_same_answer(plan, flat, parts)
+
+
+def test_time_window_parity_and_pruning_counters():
+    flat, parts = _pair()
+    for i in range(3):
+        b = _batch(seed=i, shift=i * 24 * 3600)
+        flat.insert_flows(b)
+        parts.insert_flows(b)
+    parts.flows.seal()
+    lo = int(flat.flows.scan()["flowStartSeconds"].min())
+    plan = parse_plan({"groupBy": "sourceIP", "agg": "count",
+                       "start": lo, "end": lo + 3600, "k": 0})
+    out = _assert_same_answer(plan, flat, parts)
+    assert out["partsPruned"] >= 2, out
+    assert out["partsScanned"] >= 1
+
+
+def test_string_filter_code_set_prunes_whole_parts():
+    """A string eq whose code set misses a part's unique codes skips
+    the part without touching a row."""
+    flat, parts = _pair()
+    a = _batch(seed=1)
+    flat.insert_flows(a)
+    parts.insert_flows(a)
+    parts.flows.seal()
+    # rows whose sourceIP only exists in the SECOND part
+    rows = [{"timeInserted": 1, "flowStartSeconds": 1,
+             "flowEndSeconds": 2, "sourceIP": "192.168.77.1",
+             "destinationIP": "10.0.0.1", "octetDeltaCount": 5}]
+    flat.insert_flow_rows(rows)
+    parts.insert_flow_rows(rows)
+    parts.flows.seal()
+    plan = parse_plan({"groupBy": "destinationIP", "agg": "count",
+                       "filters": [{"column": "sourceIP", "op": "=",
+                                    "value": "192.168.77.1"}],
+                       "k": 0})
+    out = _assert_same_answer(plan, flat, parts)
+    # the first part's unique-code set misses the value → it counts
+    # as PRUNED (dictionary-code pruning), not scanned
+    assert out["partsPruned"] >= 1, out
+    # duplicate values in an `in` list must not trip the
+    # assume_unique intersection
+    dup = parse_plan({"groupBy": "destinationIP", "agg": "count",
+                      "filters": [{"column": "sourceIP", "op": "in",
+                                   "value": ["192.168.77.1",
+                                             "192.168.77.1"]}],
+                      "k": 0})
+    _assert_same_answer(dup, flat, parts)
+
+
+def test_randomized_oracle_with_deletes_ttl_demotion(tmp_path, rng):
+    """The gate: random inserts, value deletes, TTL eviction, forced
+    demotion — then a battery of plans, all three answers identical."""
+    flat, parts = _pair(tmp_path, memtable_rows=96, ttl_seconds=48 * 3600)
+    t0 = None
+    for i in range(5):
+        b = _batch(n_series=int(rng.integers(10, 40)),
+                   points=int(rng.integers(4, 12)),
+                   seed=int(rng.integers(0, 1000)),
+                   shift=i * 3600)
+        if t0 is None:
+            t0 = int(b["timeInserted"].min())
+        now = int(b["timeInserted"].max())
+        flat.insert_flows(b, now=now)
+        parts.insert_flows(b, now=now)
+        if i == 2:
+            # value-based delete through the dictionary
+            ip = flat.flows.dicts["sourceIP"].decode_one(
+                int(flat.flows.scan()["sourceIP"][-1]))
+            flat.flows.delete_ids([ip], column="sourceIP")
+            parts.flows.delete_ids([ip], column="sourceIP")
+        if i == 3:
+            parts.flows.seal()
+            parts.flows.demote_oldest(0)   # everything cold
+    assert parts.flows.parts_stats()["cold"] >= 1
+    some_ip = flat.flows.dicts["destinationIP"].decode_one(
+        int(flat.flows.scan()["destinationIP"][0]))
+    plans = [
+        {"groupBy": "sourceIP", "agg": "sum:octetDeltaCount", "k": 0},
+        {"groupBy": "sourceIP,destinationIP",
+         "agg": ["count", "mean:throughput"], "k": 5},
+        {"groupBy": "destinationIP",
+         "agg": ["min:flowStartSeconds", "max:flowEndSeconds"],
+         "k": 0},
+        {"agg": ["count", "sum:reverseOctetDeltaCount"]},
+        {"groupBy": "ingressNetworkPolicyName", "agg": "count",
+         "filters": [{"column": "destinationIP", "op": "=",
+                      "value": some_ip}], "k": 0},
+        {"groupBy": "sourceIP", "agg": "sum:throughput",
+         "start": t0 + 1800, "end": t0 + 3 * 3600,
+         "timeColumn": "timeInserted", "endColumn": "timeInserted",
+         "k": 0},
+        {"groupBy": "destinationTransportPort", "agg": "count",
+         "filters": [{"column": "octetDeltaCount", "op": ">=",
+                      "value": 1000}], "k": 0},
+    ]
+    for doc in plans:
+        _assert_same_answer(parse_plan(doc), flat, parts)
+    # no read above promoted a demoted part (cold stays fileless)
+    assert all(p.chunks is None for p in parts.flows._parts
+               if p.tier == "cold")
+
+
+def test_topk_ordering_is_deterministic():
+    flat, parts = _pair()
+    b = _batch(seed=7)
+    flat.insert_flows(b)
+    parts.insert_flows(b)
+    plan = parse_plan({"groupBy": "sourceIP", "agg": "count", "k": 3})
+    r1 = QueryEngine(parts).execute(plan, use_cache=False)
+    r2 = QueryEngine(parts).execute(plan, use_cache=False)
+    assert r1["rows"] == r2["rows"]
+    counts = [r["count"] for r in r1["rows"]]
+    assert counts == sorted(counts, reverse=True)
+    assert len(r1["rows"]) == 3
+
+
+# -- cold tier ------------------------------------------------------------
+
+
+def test_cold_query_streams_without_promotion(tmp_path):
+    flat, parts = _pair(tmp_path, memtable_rows=64)
+    for i in range(3):
+        b = _batch(seed=i, shift=i * 3600)
+        flat.insert_flows(b)
+        parts.insert_flows(b)
+    parts.flows.seal()
+    parts.flows.demote_oldest(0)
+    before = parts.flows.parts_stats()
+    assert before["hotBytes"] == 0 and before["cold"] >= 3
+    lo = int(flat.flows.scan()["flowStartSeconds"].min())
+    plan = parse_plan({"groupBy": "sourceIP",
+                       "agg": "sum:octetDeltaCount",
+                       "start": lo, "end": lo + 2 * 3600, "k": 0})
+    engine = QueryEngine(parts, cold_buffer=1)
+    out = engine.execute(plan)
+    rf = QueryEngine(flat).execute(plan)
+    assert out["rows"] == rf["rows"]
+    # the acceptance check: tier residency unchanged — no cold part
+    # was promoted back to RAM by the scan
+    after = parts.flows.parts_stats()
+    assert after["hotBytes"] == before["hotBytes"] == 0
+    assert after["cold"] == before["cold"]
+    assert all(p.chunks is None for p in parts.flows._parts)
+
+
+def test_cold_global_count_touches_no_plan_columns(tmp_path):
+    """A bare global count has an EMPTY column-touch set; the cold
+    path must still carry the row count (regression: subset decode of
+    zero columns yields zero rows)."""
+    flat, parts = _pair(tmp_path, memtable_rows=64)
+    b = _batch(seed=4)
+    flat.insert_flows(b)
+    parts.insert_flows(b)
+    parts.flows.seal()
+    parts.flows.demote_oldest(0)
+    plan = parse_plan({"agg": "count"})
+    out = _assert_same_answer(plan, flat, parts)
+    assert out["rows"] == [{"count": len(b)}]
+    assert parts.flows.parts_stats()["hotBytes"] == 0
+
+
+def test_cold_part_column_subset_decode(tmp_path):
+    _, parts = _pair(tmp_path, memtable_rows=64)
+    parts.insert_flows(_batch(seed=3))
+    parts.flows.seal()
+    part = parts.flows._parts[0]
+    full = read_part_file(part.path)
+    sub = read_part_file(part.path,
+                         columns=["sourceIP", "octetDeltaCount"])
+    assert set(sub.columns) == {"sourceIP", "octetDeltaCount"}
+    np.testing.assert_array_equal(sub["octetDeltaCount"],
+                                  full["octetDeltaCount"])
+    np.testing.assert_array_equal(
+        sub.dicts["sourceIP"].decode(sub["sourceIP"]),
+        full.dicts["sourceIP"].decode(full["sourceIP"]))
+
+
+def test_projected_select_parity_including_cold(tmp_path):
+    flat, parts = _pair(tmp_path, memtable_rows=64)
+    for i in range(2):
+        b = _batch(seed=i, shift=i * 3600)
+        flat.insert_flows(b)
+        parts.insert_flows(b)
+    parts.flows.seal()
+    parts.flows.demote_oldest(0)
+    lo = int(flat.flows.scan()["flowStartSeconds"].min())
+    cols = ["sourceIP", "octetDeltaCount"]
+    sf = flat.flows.select(start_time=lo, end_time=lo + 3600,
+                           columns=cols)
+    sp = parts.flows.select(start_time=lo, end_time=lo + 3600,
+                            columns=cols)
+    assert set(sf.columns) == set(sp.columns) == set(cols)
+    np.testing.assert_array_equal(sf["octetDeltaCount"],
+                                  sp["octetDeltaCount"])
+    np.testing.assert_array_equal(sf.strings("sourceIP"),
+                                  sp.strings("sourceIP"))
+    # projection did not promote anything
+    assert parts.flows.parts_stats()["hotBytes"] == 0
+
+
+def test_cold_small_parts_merge_on_disk(tmp_path):
+    """Satellite fix: adjacent small SAME-PARTITION cold parts
+    coalesce on disk (previously only hot parts merged, so a
+    long-retention tier accumulated tiny files forever) — without
+    promoting a byte back to RAM."""
+    flat, parts = _pair(tmp_path, memtable_rows=64, part_rows=4096)
+    for i in range(4):
+        b = _batch(seed=i)     # same hour partition
+        flat.insert_flows(b)
+        parts.insert_flows(b)
+    parts.flows.seal()
+    parts.flows.demote_oldest(0)
+    before = parts.flows.parts_stats()
+    assert before["cold"] >= 4 and before["hotBytes"] == 0
+    merges = parts.flows.maintain()
+    after = parts.flows.parts_stats()
+    assert merges >= 1
+    assert after["coldMerges"] >= 1
+    assert after["cold"] < before["cold"]
+    assert after["hotBytes"] == 0          # never promoted
+    assert all(p.tier == "cold" and p.chunks is None
+               for p in parts.flows._parts)
+    # byte-identical content after the disk rewrite
+    a, b = flat.flows.scan(), parts.flows.scan()
+    assert len(a) == len(b)
+    for c in FLOW_SCHEMA:
+        np.testing.assert_array_equal(np.asarray(a[c.name]),
+                                      np.asarray(b[c.name]),
+                                      err_msg=c.name)
+    # old files are retired at the next gc; the new file exists
+    assert all(os.path.exists(p.path) for p in parts.flows._parts)
+
+
+def test_hot_merge_still_works_and_cold_skipped_without_dir():
+    _, parts = _pair(None, memtable_rows=64, part_rows=4096)
+    for i in range(4):
+        parts.insert_flows(_batch(seed=i))
+    parts.flows.seal()
+    assert parts.flows.parts_stats()["count"] >= 2
+    merges = parts.flows.maintain()
+    st = parts.flows.parts_stats()
+    assert merges >= 1 and st["merges"] >= 1
+    assert st["coldMerges"] == 0   # no directory → no cold tier
+
+
+# -- result cache ---------------------------------------------------------
+
+
+def test_cache_hit_and_structural_invalidation(tmp_path):
+    flat, parts = _pair(tmp_path, memtable_rows=64, part_rows=4096)
+    for i in range(3):
+        b = _batch(seed=i)
+        flat.insert_flows(b)
+        parts.insert_flows(b)
+    parts.flows.seal()
+    engine = QueryEngine(parts)
+    plan = parse_plan({"groupBy": "sourceIP", "agg": "count", "k": 0})
+    first = engine.execute(plan)
+    assert first["cache"] == "miss"
+    hit = engine.execute(plan)
+    assert hit["cache"] == "hit" and hit["rows"] == first["rows"]
+    # an insert moves the fingerprint
+    parts.insert_flows(_batch(seed=9))
+    after_insert = engine.execute(plan)
+    assert after_insert["cache"] == "miss"
+    # a merge moves the fingerprint but not the answer
+    parts.flows.seal()
+    warmed = engine.execute(plan)
+    assert engine.execute(plan)["cache"] == "hit"
+    assert parts.flows.maintain() >= 1
+    after_merge = engine.execute(plan)
+    assert after_merge["cache"] == "miss"
+    assert after_merge["rows"] == warmed["rows"]
+    # demotion moves the fingerprint too (tier is part of the key)
+    assert engine.execute(plan)["cache"] == "hit"
+    parts.flows.demote_oldest(0)
+    after_demote = engine.execute(plan)
+    assert after_demote["cache"] == "miss"
+    assert after_demote["rows"] == warmed["rows"]
+    stats = engine.cache.stats()
+    assert stats["hits"] >= 3 and stats["misses"] >= 4
+
+
+def test_cache_bounded_by_bytes():
+    flat, parts = _pair()
+    parts.insert_flows(_batch(seed=1))
+    engine = QueryEngine(parts, cache_bytes=1)   # nothing fits
+    plan = parse_plan({"groupBy": "sourceIP", "agg": "count", "k": 0})
+    engine.execute(plan)
+    assert engine.execute(plan)["cache"] == "miss"
+    assert engine.cache.stats()["entries"] == 0
+
+
+# -- kernels --------------------------------------------------------------
+
+
+def test_kernels_jax_numpy_bit_parity(monkeypatch, rng):
+    keys = rng.integers(0, 50, size=(4096, 2)).astype(np.int64)
+    values = {
+        "a": rng.integers(-(10 ** 12), 10 ** 12, 4096).astype(np.int64),
+        "b": rng.integers(0, 10 ** 9, 4096).astype(np.int64)}
+    specs = [("count", "count", None), ("sum(a)", "sum", "a"),
+             ("min(b)", "min", "b"), ("max(a)", "max", "a")]
+    monkeypatch.setenv("THEIA_QUERY_JAX", "0")
+    uk_np, agg_np = qkernels.aggregate(keys, values, specs)
+    assert qkernels.kernel_mode() == "numpy"
+    monkeypatch.setenv("THEIA_QUERY_JAX", "1")
+    assert qkernels.kernel_mode() == "jax"
+    uk_jx, agg_jx = qkernels.aggregate(keys, values, specs)
+    np.testing.assert_array_equal(uk_np, uk_jx)
+    for label, _, _ in specs:
+        np.testing.assert_array_equal(agg_np[label], agg_jx[label],
+                                      err_msg=label)
+
+
+def test_kernel_mode_auto_respects_x64():
+    # conftest enables x64 on the CPU test config, so auto → jax here
+    import jax
+    expected = "jax" if jax.config.jax_enable_x64 else "numpy"
+    assert qkernels.kernel_mode() in (expected, "numpy")
+
+
+def test_merge_partials_semantics(rng):
+    specs = [("count", "count", None), ("sum(a)", "sum", "a"),
+             ("min(a)", "min", "a"), ("max(a)", "max", "a")]
+    keys = rng.integers(0, 10, size=(512, 1)).astype(np.int64)
+    vals = {"a": rng.integers(-100, 100, 512).astype(np.int64)}
+    whole_k, whole = qkernels.aggregate(keys, vals, specs)
+    half_a = qkernels.aggregate(keys[:200], {"a": vals["a"][:200]},
+                                specs)
+    half_b = qkernels.aggregate(keys[200:], {"a": vals["a"][200:]},
+                                specs)
+    merged_k, merged = qkernels.merge_partials([half_a, half_b], specs)
+    np.testing.assert_array_equal(whole_k, merged_k)
+    for label, _, _ in specs:
+        np.testing.assert_array_equal(whole[label], merged[label],
+                                      err_msg=label)
+
+
+# -- sharded stores -------------------------------------------------------
+
+
+def test_cold_merge_gc_gives_readers_a_grace_pass(tmp_path):
+    """A reader that snapshotted the part list just before a cold
+    merge retired a run must still be able to decode those files: the
+    manifest-less maintenance GC unlinks a file only after TWO
+    consecutive passes found it unreferenced."""
+    _, parts = _pair(tmp_path, memtable_rows=64, part_rows=4096)
+    for i in range(4):
+        parts.insert_flows(_batch(seed=i))
+    parts.flows.seal()
+    parts.flows.demote_oldest(0)
+    held, _ = parts.flows._snapshot_refs()   # a slow reader's view
+    assert parts.flows.maintain() >= 1       # cold merge + GC pass 1
+    # the retired files survive the first pass — the reader can
+    # still stream every part it captured
+    total = sum(len(parts.flows._decode_part(p)) for p in held)
+    assert total == sum(p.rows for p in held)
+    # the NEXT pass (reader gone) collects them
+    parts.flows.maintain()
+    import glob
+    live = {os.path.basename(p.path) for p in parts.flows._parts}
+    on_disk = {os.path.basename(f) for f in
+               glob.glob(str(tmp_path / "parts" / "part-*.tprt"))}
+    assert on_disk == live
+
+
+def test_sharded_numeric_groupby_tiebreak_matches_plain():
+    """Equal aggregate values tie-break by the NUMERIC key value in
+    the sharded merge path too (an object-dtype key column would
+    compare '80' < '9' as strings)."""
+    rows = [{"timeInserted": 1, "flowStartSeconds": 1,
+             "flowEndSeconds": 2, "destinationTransportPort": port,
+             "octetDeltaCount": 12}
+            for port in (80, 9)]
+    plain = FlowDatabase(engine="flat")
+    plain.insert_flow_rows(rows)
+    sharded = ShardedFlowDatabase(n_shards=2, seed=3)
+    sharded.insert_flow_rows(rows)
+    plan = parse_plan({"groupBy": "destinationTransportPort",
+                       "agg": "sum:octetDeltaCount", "k": 1})
+    rp = QueryEngine(plain).execute(plan, use_cache=False)
+    rs = QueryEngine(sharded).execute(plan, use_cache=False)
+    assert rp["rows"] == rs["rows"]
+    assert rp["rows"][0]["destinationTransportPort"] == 9
+
+
+def test_sharded_query_merges_across_dictionaries():
+    db = ShardedFlowDatabase(n_shards=3, seed=11)
+    b = _batch(seed=5, n_series=30)
+    db.insert_flows(b)
+    plan = parse_plan({"groupBy": "sourceIP,destinationIP",
+                       "aggregates": ["count", "sum:octetDeltaCount",
+                                      "min:throughput"],
+                       "k": 0})
+    out = QueryEngine(db).execute(plan, use_cache=False)
+    scan = db.flows.scan()   # concat reconciles shard dictionaries
+    rows_ref, groups_ref, _ = reference_execute(plan, scan, scan.dicts)
+    assert out["rows"] == rows_ref
+    assert out["groupCount"] == groups_ref
+
+
+# -- admission ladder -----------------------------------------------------
+
+
+def test_admission_query_rung(monkeypatch):
+    from theia_tpu.manager.admission import (AdmissionController,
+                                             AdmissionRejected)
+    adm = AdmissionController(rate=1e9)
+    assert adm.admit_query() == 0
+    monkeypatch.setenv("THEIA_ADMISSION_FORCE_LEVEL", "sampled")
+    assert adm.admit_query() == 1     # sampled still serves queries
+    monkeypatch.setenv("THEIA_ADMISSION_FORCE_LEVEL", "shed_detector")
+    with pytest.raises(AdmissionRejected) as e:
+        adm.admit_query()
+    assert e.value.reason == "query_shed"
+    monkeypatch.setenv("THEIA_ADMISSION_FORCE_LEVEL", "reject")
+    with pytest.raises(AdmissionRejected):
+        adm.admit_query()
+
+
+# -- HTTP surface ---------------------------------------------------------
+
+
+@pytest.fixture()
+def server(monkeypatch):
+    from theia_tpu.manager import TheiaManagerServer
+    monkeypatch.setenv("THEIA_RETENTION_INTERVAL", "0")
+    db = FlowDatabase(engine="parts",
+                      parts_config={"memtable_rows": 256})
+    for i in range(2):
+        db.insert_flows(_batch(seed=i, n_series=30))
+    srv = TheiaManagerServer(db, port=0, workers=1)
+    srv.start_background()
+    yield srv
+    srv.shutdown()
+
+
+def _get_json(srv, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}{path}", timeout=10) as r:
+        return json.loads(r.read())
+
+
+def test_query_http_get_post_and_errors(server, monkeypatch):
+    body = {"groupBy": "sourceIP",
+            "aggregates": ["sum:octetDeltaCount", "count"], "k": 5}
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/query",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        posted = json.loads(r.read())
+    assert posted["engine"] == "parts" and len(posted["rows"]) == 5
+    got = _get_json(server,
+                    "/query?group_by=sourceIP"
+                    "&agg=sum:octetDeltaCount,count&k=5")
+    assert got["rows"] == posted["rows"]
+    assert got["cache"] == "hit"
+    # malformed plan → 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get_json(server, "/query?group_by=noSuchColumn")
+    assert e.value.code == 400
+    # healthz carries the query section; /metrics exposes the series
+    doc = _get_json(server, "/healthz")
+    assert doc["query"]["queries"] >= 2
+    assert doc["query"]["cache"]["hits"] >= 1
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/metrics",
+            timeout=10) as r:
+        text = r.read().decode()
+    assert "theia_query_seconds" in text
+    assert "theia_query_cache_hits_total" in text
+    # shed rung: queries 429 with Retry-After, control plane serves on
+    monkeypatch.setenv("THEIA_ADMISSION_FORCE_LEVEL", "shed_detector")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get_json(server, "/query?agg=count")
+    assert e.value.code == 429
+    assert int(e.value.headers["Retry-After"]) >= 1
+    assert _get_json(server, "/healthz")["status"] == "degraded"
+
+
+def test_query_auth_gated(monkeypatch):
+    from theia_tpu.manager import TheiaManagerServer
+    monkeypatch.setenv("THEIA_RETENTION_INTERVAL", "0")
+    db = FlowDatabase(engine="flat")
+    db.insert_flows(_batch())
+    srv = TheiaManagerServer(db, port=0, workers=1,
+                             auth_token="sekrit")
+    srv.start_background()
+    try:
+        url = f"http://127.0.0.1:{srv.port}/query?agg=count"
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(url, timeout=10)
+        assert e.value.code == 401
+        req = urllib.request.Request(
+            url, headers={"Authorization": "Bearer sekrit"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            doc = json.loads(r.read())
+        assert doc["rows"][0]["count"] == len(db.flows)
+        assert doc["engine"] == "flat"
+    finally:
+        srv.shutdown()
+
+
+def test_flat_engine_served_through_engine_object():
+    db = FlowDatabase(engine="flat")
+    b = _batch(seed=2)
+    db.insert_flows(b)
+    out = QueryEngine(db).execute(
+        parse_plan({"groupBy": "sourceIP", "agg": "count", "k": 0}))
+    assert out["engine"] == "flat"
+    assert sum(r["count"] for r in out["rows"]) == len(b)

@@ -657,6 +657,64 @@ def ingest_cmd(args) -> None:
           f"{s['transientRetries']} transient retries")
 
 
+# -- query (filtered aggregations over the store — the vectorized
+# -- read path of the parts engine) -------------------------------------
+
+_WHERE_OPS = (">=", "<=", "!=", ">", "<", "=")
+
+
+def _parse_where(clause: str) -> dict:
+    """One --where clause → filter doc: `col>=443`, `sourceIP=10.0.0.9`,
+    `destinationIP in 10.0.0.1,10.0.0.2`."""
+    if " in " in clause:
+        column, _, raw = clause.partition(" in ")
+        return {"column": column.strip(), "op": "in",
+                "value": [v for v in raw.strip().split(",") if v]}
+    for op in _WHERE_OPS:
+        if op in clause:
+            column, _, value = clause.partition(op)
+            return {"column": column.strip(), "op": op,
+                    "value": value.strip()}
+    raise SystemExit(
+        f"error: --where {clause!r} has no operator "
+        f"(expected one of {_WHERE_OPS} or ' in ')")
+
+
+def query_cmd(args) -> None:
+    """Run one filtered aggregation through POST /query and print the
+    result rows (the CLI face of the vectorized query engine)."""
+    doc: dict = {}
+    if args.group_by:
+        doc["groupBy"] = args.group_by
+    if args.agg:
+        doc["aggregates"] = args.agg
+    if args.where:
+        doc["filters"] = [_parse_where(w) for w in args.where]
+    for name in ("start", "end", "k"):
+        v = getattr(args, name)
+        if v is not None:
+            doc[name] = v
+    if args.time_column:
+        doc["timeColumn"] = args.time_column
+    if args.order_by:
+        doc["orderBy"] = args.order_by
+    out = _request(args.manager_addr, "POST", "/query", doc)
+    if args.json:
+        print(json.dumps(out, indent=2))
+        return
+    rows = out.get("rows", [])
+    if rows:
+        _print_table(rows, list(rows[0].keys()))
+    else:
+        print("no groups matched")
+    print(f"-- {out.get('groupCount', 0)} groups, "
+          f"{out.get('rowsScanned', 0):,} rows scanned, "
+          f"{out.get('partsScanned', 0)} parts scanned / "
+          f"{out.get('partsPruned', 0)} pruned, "
+          f"{out.get('engine')} engine, cache {out.get('cache')}, "
+          f"{out.get('tookMs', 0)} ms")
+
+
 # -- top (live rates from GET /metrics; no reference equivalent — the
 # -- closest is watching the provisioned Grafana dashboards) ------------
 
@@ -755,6 +813,32 @@ def top(args) -> None:
                       f"cold {cold / 1e6:,.1f} MB, "
                       f"{dm / dt_p if dt_p > 0 else 0.0:,.2f} "
                       f"merges/s")
+            qc = sample.get(("theia_query_seconds_count", ()))
+            if qc is not None:
+                # query-engine header: query rate, scan rate, cache
+                # hit ratio — scrape-to-scrape deltas. q/s = cache
+                # hits + executed queries (the seconds histogram):
+                # the histogram alone misses cache hits, the cache
+                # counters alone miss everything when the cache is
+                # disabled — either half would read as an idle engine
+                # under the other workload.
+                def _qdelta(name):
+                    if prev is None:
+                        return 0.0
+                    return max(sample.get((name, ()), 0.0)
+                               - prev.get((name, ()), 0.0), 0.0)
+                dt_q = now - prev_t if prev is not None else 0.0
+                dscan = _qdelta("theia_query_rows_scanned_total")
+                dh = _qdelta("theia_query_cache_hits_total")
+                dm_q = _qdelta("theia_query_cache_misses_total")
+                dq = dh + _qdelta("theia_query_seconds_count")
+                hit_pct = (100.0 * dh / (dh + dm_q)
+                           if (dh + dm_q) > 0 else 0.0)
+                print(f"query engine: "
+                      f"{dq / dt_q if dt_q > 0 else 0.0:,.1f} q/s, "
+                      f"{dscan / dt_q if dt_q > 0 else 0.0:,.0f} "
+                      f"rows/s scanned, "
+                      f"cache hit {hit_pct:.0f}%")
             qd = sample.get(("theia_fused_queue_depth", ()))
             if qd is not None:
                 # fused-engine header: pipeline backlog + step rate +
@@ -1005,6 +1089,35 @@ def build_parser() -> argparse.ArgumentParser:
                      help="seconds between batches (0 = flat out)")
     ing.add_argument("--seed", type=int, default=0)
     ing.set_defaults(fn=ingest_cmd)
+
+    q = sub.add_parser(
+        "query",
+        help="filtered aggregation over the flow store (the "
+             "vectorized /query read path)")
+    q.add_argument("--group-by", default="",
+                   help="comma-separated group-by columns "
+                        "(e.g. sourceIP,destinationIP)")
+    q.add_argument("--agg", action="append", default=[],
+                   help="aggregate op:column (sum:octetDeltaCount, "
+                        "mean:throughput) or `count`; repeatable")
+    q.add_argument("--where", action="append", default=[],
+                   help="filter clause: col>=443, sourceIP=10.0.0.9, "
+                        "destinationIP in a,b; repeatable (ANDed)")
+    q.add_argument("--start", type=int, default=None,
+                   help="window start (unix seconds, inclusive)")
+    q.add_argument("--end", type=int, default=None,
+                   help="window end (unix seconds, exclusive)")
+    q.add_argument("--time-column", default="",
+                   help="window start column (default "
+                        "flowStartSeconds)")
+    q.add_argument("-k", type=int, default=None,
+                   help="top-K groups by --order-by (0 = all)")
+    q.add_argument("--order-by", default="",
+                   help="aggregate label to order by (default: the "
+                        "first aggregate)")
+    q.add_argument("--json", action="store_true",
+                   help="print the raw result document")
+    q.set_defaults(fn=query_cmd)
 
     sb = sub.add_parser("supportbundle")
     sb.add_argument("-f", "--file", default="")

@@ -43,7 +43,9 @@ acknowledged):
     rung 1  sampled        detector/scoring leg runs on a declining
                            fraction of batches (fraction falls as
                            pressure rises through the band)
-    rung 2  shed_detector  scoring fully shed; ingest stays durable
+    rung 2  shed_detector  scoring fully shed; ingest stays durable;
+                           heavy `/query` reads answer 429 (deferrable
+                           analytics shed one rung before ingest does)
     rung 3  reject         new ingest answers 429 + Retry-After
 
 Rung transitions are hysteretic: escalation is immediate, de-escalation
@@ -505,6 +507,28 @@ class AdmissionController:
             if wait > 0.0:
                 self.reject("bytes", wait,
                              f"{nbytes} payload bytes over budget")
+        with self._lock:
+            self.admitted += 1
+        return level
+
+    def admit_query(self) -> int:
+        """Gate one `/query` request. Analytics queries are DEFERRABLE
+        read work, so they ride the pressure ladder one rung ahead of
+        ingest: at `shed_detector` (rung 2) — where ingest is still
+        accepted, just unscored — queries already answer 429 +
+        Retry-After, and at `reject` likewise. Control/observability
+        endpoints (/healthz, /readyz, /metrics, /alerts) never shed;
+        only the heavy read path does. Returns the rung on success."""
+        try:
+            _fire_fault("admission.pressure", stream="__query__")
+        except FaultError as e:
+            self.reject("fault", self.retry_after_hint, str(e))
+        level = self.evaluate()
+        if level >= LEVEL_SHED:
+            self.reject(
+                "query_shed", self.retry_after_hint,
+                f"brownout rung {LEVEL_NAMES[level]} sheds analytics "
+                f"queries (pressure {self.pressure():.2f})")
         with self._lock:
             self.admitted += 1
         return level

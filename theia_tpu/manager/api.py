@@ -211,6 +211,7 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
     ingest = None     # IngestManager
     retention = None  # RetentionLoop
     maintenance = None  # PartMaintenanceLoop (parts engine)
+    queries = None    # QueryEngine
     auth_token: Optional[str] = None
     quiet = True
     # Socket timeout (StreamRequestHandler honors it): a client that
@@ -318,10 +319,15 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
     # -- verbs -----------------------------------------------------------
 
     def do_GET(self) -> None:  # noqa: N802
+        from .admission import AdmissionRejected
         try:
             self._get()
         except AuthError as e:
             self._send_auth_error(e)
+        except AdmissionRejected as e:
+            # heavy reads (/query) ride the pressure ladder — over
+            # capacity is 429 + Retry-After, distinct from 503
+            self._send_retry_after(e)
         except AllReplicasDownError as e:
             # "retry later", not "server bug": every store copy is out
             self._send_error_json(503, str(e))
@@ -398,6 +404,15 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
             self._require_auth()
             limit = int(self._query().get("limit", "100"))
             self._send_json(_obs_prom.traces_doc(limit))
+            return
+        if parts == ("query",):
+            # Aggregation results decode flow identities (IPs, pods) —
+            # the /alerts sensitivity class, so the token (when
+            # configured) is required; the query itself rides the
+            # admission pressure ladder (heavy reads shed at the
+            # shed_detector rung, 429 + Retry-After).
+            self._require_auth()
+            self._serve_query(self._plan_from_get())
             return
         if parts == ("healthz",):
             self._send_json(self._health_doc())
@@ -545,6 +560,13 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
                 doc["status"] = "degraded"
         if self.retention is not None:
             doc["retention"] = self.retention.stats()
+        # Query engine: executed count, worker/cold-buffer sizing,
+        # kernel in use, and result-cache occupancy/hit counters.
+        # (getattr like `maintenance` below: stub handler objects in
+        # tests don't carry every binding)
+        queries = getattr(self, "queries", None)
+        if queries is not None:
+            doc["query"] = queries.stats()
         # Storage engine + tier summary (parts engine: part counts,
         # hot/cold bytes, memtable, merge/seal/demote totals). The
         # attribute lookup itself can raise on a replicated store with
@@ -706,8 +728,28 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
             return
         raise KeyError(self.path)
 
+    def _plan_from_get(self):
+        from ..query import plan_from_params
+        return plan_from_params(self._query())
+
+    def _serve_query(self, plan) -> None:
+        """Shared GET/POST /query tail: admission, execution, timing
+        headers. 400s (PlanError is a ValueError) and 429s surface
+        through the verb handlers' taxonomy."""
+        if self.queries is None:
+            raise KeyError(self.path)
+        adm = getattr(self.ingest, "admission", None) \
+            if self.ingest is not None else None
+        if adm is not None:
+            adm.admit_query()
+        self._send_json(self.queries.execute(plan))
+
     def _post(self) -> None:
         parts = self._route()
+        if parts == ("query",):
+            from ..query import parse_plan
+            self._serve_query(parse_plan(self._read_body()))
+            return
         if parts == ("ingest",):
             q = self._query()
             stream = q.get("stream", "default")
@@ -832,6 +874,11 @@ class TheiaManagerServer:
                 "jobQueue", self.controller._queue.qsize,
                 _env_int("THEIA_JOB_QUEUE_HIGH", 64))
         self.stats = StatsProvider(db, capacity_bytes=capacity_bytes)
+        # Vectorized read path: filtered aggregations over the store
+        # (part-native on the parts engine, reference executor on
+        # flat) behind GET/POST /query.
+        from ..query import QueryEngine
+        self.queries = QueryEngine(db)
         self.bundles = SupportBundleManager(self.controller, self.stats,
                                             ingest=self.ingest)
         from .profiling import ProfileManager
@@ -881,6 +928,7 @@ class TheiaManagerServer:
             "ingest": self.ingest,
             "retention": self.retention,
             "maintenance": self.maintenance,
+            "queries": self.queries,
             "auth_token": self.auth_token,
         })
         self.httpd = _TLSCapableServer((address, port), handler)

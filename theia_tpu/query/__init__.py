@@ -1,0 +1,21 @@
+"""Vectorized query engine over the part-based column store.
+
+`plan.py` parses/normalizes queries, `engine.py` executes them
+part-natively (pruned, encoded-space filters, late-materializing
+group-by, bounded-pool parallelism, cold streaming, result cache),
+`kernels.py` holds the aggregation kernels (numpy reduceat / jitted
+jnp segment reductions), and `reference.py` is the slow-but-correct
+oracle the whole path is gated against.
+"""
+
+from .engine import QueryCache, QueryEngine, QueryError
+from .kernels import kernel_mode
+from .plan import (AGG_OPS, Aggregate, Filter, PlanError, QueryPlan,
+                   parse_plan, plan_from_params)
+from .reference import reference_execute
+
+__all__ = [
+    "AGG_OPS", "Aggregate", "Filter", "PlanError", "QueryCache",
+    "QueryEngine", "QueryError", "QueryPlan", "kernel_mode",
+    "parse_plan", "plan_from_params", "reference_execute",
+]

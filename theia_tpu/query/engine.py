@@ -1,0 +1,685 @@
+"""Vectorized query engine over the part-based column store.
+
+The read-side twin of the PR-6 fused detector: where PR 7 made the
+flows table a set of immutable, width-reduced, dictionary-coded column
+parts, this module runs filtered aggregations DIRECTLY over that
+encoding — the ARIMA_PLUS "push analytics into the store" pattern —
+instead of decoding parts back to table code space and aggregating a
+materialized copy:
+
+  1. **Plan → prune.** Part min/max metadata (the PR-7 pruning
+     substrate) drops parts that cannot overlap the time window or a
+     numeric filter's range before any column is touched.
+  2. **Filters in encoded space.** On a hot part, a numeric predicate
+     compares the WIDTH-REDUCED stored array against the rebased
+     threshold (`v - base`, clamped: an out-of-range threshold decides
+     the whole part without widening a single row); a string predicate
+     resolves to table-global dictionary codes ONCE per query, then
+     per part intersects the part's unique-code set — a miss skips the
+     part entirely, a hit turns into a boolean gather over the narrow
+     local indices. No strings, no widening, no row materialization.
+  3. **Late-materializing group-by.** Group keys aggregate in the
+     part's LOCAL code space (u1/u2 indices); only the SURVIVING
+     groups map local → global codes (strings) or `+ base`
+     (numerics). Aggregation itself is query/kernels.py — lexsort +
+     reduceat, or one jitted `jnp` segment-reduction dispatch
+     (`THEIA_QUERY_JAX`, the THEIA_FUSED_PALLAS auto/fallback
+     discipline).
+  4. **Parallel per-part execution.** Live parts are striped across a
+     bounded pool (`THEIA_QUERY_WORKERS`); each worker folds its
+     parts into ONE per-worker partial accumulator, and the partials
+     merge exactly (count via sum, min via min, ...).
+  5. **Cold tier stays cold.** A demoted part streams through a
+     bounded decode buffer (`THEIA_QUERY_COLD_BUFFER` concurrent
+     decodes), decoding ONLY the columns the plan touches
+     (column-subset part-file decode), and is never promoted back to
+     RAM — the hot/cold working-set split of arXiv:1902.04143 holds
+     under scans.
+  6. **Result cache.** Finalized results cache under (normalized
+     plan, store-state fingerprint); any seal/merge/demote/delete/
+     insert changes the fingerprint, so invalidation is structural,
+     not timed (`THEIA_QUERY_CACHE_BYTES`).
+
+The flat engine and the parts memtable take the slow-but-correct
+reference executor path (query/reference.py); the randomized oracle
+suite (tests/test_query.py) holds every path bit-identical.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..obs import metrics as _metrics
+from ..schema import ColumnarBatch
+from ..utils.env import env_int
+from ..utils.logging import get_logger
+from ..utils.pool import get_pool
+from . import kernels
+from .plan import QueryPlan
+from .reference import filter_mask, materialize_keys, reference_partial
+from .result import empty_result, finalize, lower_specs, value_columns
+
+logger = get_logger("query")
+
+DEFAULT_WORKERS = min(8, os.cpu_count() or 1)
+DEFAULT_CACHE_BYTES = 16 << 20
+DEFAULT_COLD_BUFFER = 2
+
+_M_SECONDS = _metrics.histogram(
+    "theia_query_seconds",
+    "End-to-end query-engine execution time (cache misses; hits are "
+    "counted separately)")
+_M_ROWS_SCANNED = _metrics.counter(
+    "theia_query_rows_scanned_total",
+    "Rows evaluated by the query engine (part rows after pruning + "
+    "memtable rows)")
+_M_PARTS_SCANNED = _metrics.counter(
+    "theia_query_parts_scanned_total",
+    "Parts evaluated by queries after pruning")
+_M_PARTS_PRUNED = _metrics.counter(
+    "theia_query_parts_pruned_total",
+    "Parts skipped by query min/max + dictionary-code pruning (read "
+    "with theia_query_parts_scanned_total for the prune ratio)")
+_M_CACHE_HITS = _metrics.counter(
+    "theia_query_cache_hits_total",
+    "Queries answered from the result cache (same normalized plan, "
+    "unchanged store fingerprint)")
+_M_CACHE_MISSES = _metrics.counter(
+    "theia_query_cache_misses_total",
+    "Queries that had to execute (cold cache, or the store fingerprint "
+    "moved under seal/merge/demote/insert/delete)")
+
+
+class QueryError(Exception):
+    """The engine could not execute a valid plan (store-side issue)."""
+
+
+# -- compiled predicates ---------------------------------------------------
+
+class _CompiledFilter:
+    """One plan filter resolved against a concrete table: string
+    values → sorted global dictionary codes (resolved once per query,
+    not per part)."""
+
+    __slots__ = ("column", "op", "value", "codes", "is_string")
+
+    def __init__(self, f, table) -> None:
+        self.column = f.column
+        self.op = f.op
+        self.value = f.value
+        d = table.dicts.get(f.column)
+        self.is_string = d is not None
+        self.codes: Optional[np.ndarray] = None
+        if self.is_string:
+            values = (f.value if isinstance(f.value, tuple)
+                      else (f.value,))
+            # unique, not just sorted: isin(assume_unique=True)
+            # downstream requires it, and `in` values may repeat.
+            # int32 — the dictionaries' native code dtype — so the
+            # per-part intersections below need no conversions.
+            self.codes = np.unique(np.asarray(
+                [c for c in (d.lookup(str(v)) for v in values)
+                 if c is not None], np.int32))
+
+    def excludes_part(self, part) -> bool:
+        """True when this predicate PROVABLY matches no row of a hot
+        part, from resident metadata alone: eq/in whose resolved code
+        set misses the part's unique-code set (or resolved to nothing
+        at all). The dictionary-code half of part pruning."""
+        if not self.is_string or self.op == "ne":
+            return False
+        if not len(self.codes):
+            return True        # value(s) not in the table dictionary
+        chunks = part.chunks
+        chunk = chunks.get(self.column) if chunks is not None else None
+        if chunk is None or not hasattr(chunk, "uniq"):
+            return False       # cold/lazy: no resident code set
+        return not np.isin(chunk.uniq, self.codes,
+                           assume_unique=True).any()
+
+
+def _minmax_excludes(mm: Tuple[int, int], op: str, value) -> bool:
+    """True when part min/max PROVES no row can match a numeric
+    predicate (the filter-level analogue of window pruning)."""
+    lo, hi = mm
+    if op == "ge":
+        return hi < value
+    if op == "gt":
+        return hi <= value
+    if op == "le":
+        return lo > value
+    if op == "lt":
+        return lo >= value
+    if op == "eq":
+        return value < lo or value > hi
+    if op == "in":
+        return all(v < lo or v > hi for v in value)
+    return False   # ne: metadata can't exclude
+
+
+def _cmp_encoded(chunk, op: str, value: int) -> object:
+    """Evaluate `col <op> value` on a width-reduced numeric chunk
+    WITHOUT widening: compare the narrow stored array against the
+    rebased threshold. Returns a bool array, or True/False when the
+    rebased threshold falls outside the stored dtype's range (the
+    whole part decides at once)."""
+    s = chunk.stored
+    if op == "in":
+        vals = np.asarray(value, np.int64) - chunk.base
+        lo, hi = (np.iinfo(s.dtype).min, np.iinfo(s.dtype).max) \
+            if s.dtype.kind in "iu" else (-np.inf, np.inf)
+        vals = vals[(vals >= lo) & (vals <= hi)]
+        if not len(vals):
+            return False
+        return np.isin(s, vals.astype(s.dtype))
+    t = value - chunk.base
+    if s.dtype.kind in "iu":
+        info = np.iinfo(s.dtype)
+        if t < info.min:     # every stored value is above t
+            return {"ge": True, "gt": True, "le": False,
+                    "lt": False, "eq": False, "ne": True}[op]
+        if t > info.max:     # every stored value is below t
+            return {"ge": False, "gt": False, "le": True,
+                    "lt": True, "eq": False, "ne": True}[op]
+        t = s.dtype.type(t)
+    return {"eq": s == t, "ne": s != t, "ge": s >= t,
+            "gt": s > t, "le": s <= t, "lt": s < t}[op]
+
+
+def _and_mask(mask, m) -> object:
+    """AND-combine masks where True means all rows / False means no
+    rows (short-circuit forms the encoded comparisons return)."""
+    if m is True or mask is False:
+        return mask
+    if mask is True or m is False:
+        return m
+    mask &= m
+    return mask
+
+
+# -- result cache ----------------------------------------------------------
+
+class QueryCache:
+    """LRU-by-bytes cache of finalized result docs keyed by
+    (normalized plan, store-state fingerprint). Invalidation is the
+    fingerprint moving — every seal, merge, demote, delete, and insert
+    changes it — so a stale hit is structurally impossible."""
+
+    def __init__(self, max_bytes: Optional[int] = None) -> None:
+        self.max_bytes = (
+            env_int("THEIA_QUERY_CACHE_BYTES", DEFAULT_CACHE_BYTES)
+            if max_bytes is None else int(max_bytes))
+        self._entries: "collections.OrderedDict[tuple, Tuple[dict, int]]" = (
+            collections.OrderedDict())
+        self._bytes = 0
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def lookup(self, key: tuple) -> Optional[dict]:
+        if self.max_bytes <= 0:
+            return None
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return entry[0]
+
+    @staticmethod
+    def _estimate_bytes(doc: dict) -> int:
+        """Cheap structural size estimate for the LRU byte charge —
+        a full json.dumps here would serialize every result doc a
+        second time (the HTTP layer already pays one) just to weigh
+        it, which is worst exactly on the large results the cache
+        exists to help. String values are charged at their REAL
+        length (sampled from the first row): pod-label group keys run
+        to kilobytes, and a flat per-value charge would let the
+        configured byte budget retain 10x its size."""
+        rows = doc.get("rows") or ()
+        if not rows:
+            return 512
+        per_row = 24 + sum(
+            (len(k) + len(v) + 49) if isinstance(v, str)
+            else (len(k) + 40)
+            for k, v in rows[0].items())
+        return 512 + len(rows) * per_row
+
+    def store(self, key: tuple, doc: dict) -> None:
+        if self.max_bytes <= 0:
+            return
+        nbytes = self._estimate_bytes(doc)
+        if nbytes > self.max_bytes:
+            return
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+            self._entries[key] = (doc, nbytes)
+            self._bytes += nbytes
+            while self._bytes > self.max_bytes and self._entries:
+                _, (_, n) = self._entries.popitem(last=False)
+                self._bytes -= n
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+
+    def stats(self) -> Dict[str, object]:
+        with self._lock:
+            return {"entries": len(self._entries),
+                    "bytes": self._bytes,
+                    "maxBytes": self.max_bytes,
+                    "hits": self.hits, "misses": self.misses}
+
+
+# -- the engine ------------------------------------------------------------
+
+Partial = Optional[Tuple[np.ndarray, Dict[str, np.ndarray]]]
+
+
+class QueryEngine:
+    """Executes QueryPlans over a FlowDatabase (plain, sharded, or
+    replicated; parts or flat engine). Thread-safe; one instance per
+    manager."""
+
+    def __init__(self, db,
+                 workers: Optional[int] = None,
+                 cache_bytes: Optional[int] = None,
+                 cold_buffer: Optional[int] = None) -> None:
+        self.db = db
+        self.workers = max(1, (
+            env_int("THEIA_QUERY_WORKERS", DEFAULT_WORKERS)
+            if workers is None else int(workers)))
+        self.cold_buffer = max(1, (
+            env_int("THEIA_QUERY_COLD_BUFFER", DEFAULT_COLD_BUFFER)
+            if cold_buffer is None else int(cold_buffer)))
+        self._cold_sem = threading.Semaphore(self.cold_buffer)
+        self.cache = QueryCache(cache_bytes)
+        self.queries = 0
+        self._lock = threading.Lock()
+
+    # -- store resolution --------------------------------------------------
+
+    def _tables(self) -> List[object]:
+        """Concrete flow tables to query: one for plain/replicated
+        (the active replica resolves through __getattr__ — all
+        replicas down raises, surfacing as 503), every shard for a
+        sharded store."""
+        flows = self.db.flows
+        if hasattr(flows, "tables"):
+            return list(flows.tables)
+        return [flows]
+
+    @staticmethod
+    def _table_state(table) -> tuple:
+        """Cache-fingerprint component for one table: covers inserts/
+        deletes (generation), seals (memtable length + part set),
+        merges (part uids), and demotions (tiers)."""
+        parts = getattr(table, "_parts", None)
+        if parts is not None:
+            with table._lock:
+                return (table.generation, table._memtable_len,
+                        tuple((p.uid, p.tier) for p in table._parts))
+        return (table.generation, len(table))
+
+    def fingerprint(self, tables: Optional[List[object]] = None
+                    ) -> tuple:
+        """Cache-key component covering the whole store state; pass
+        `tables` to fingerprint an already-resolved snapshot (execute
+        does — key and execution must cover the same table set)."""
+        if tables is None:
+            tables = self._tables()
+        return tuple(self._table_state(t) for t in tables)
+
+    # -- public API --------------------------------------------------------
+
+    def execute(self, plan: QueryPlan,
+                use_cache: bool = True) -> Dict[str, object]:
+        """Run one plan; returns the result doc. Raises PlanError
+        (from parsing, upstream), QueryError, or the store's
+        availability errors."""
+        with self._lock:
+            self.queries += 1
+        t0 = time.perf_counter()
+        tables = self._tables()
+        key = (plan.normalized(), self.fingerprint(tables))
+        # a disabled cache (THEIA_QUERY_CACHE_BYTES=0) reports "off",
+        # not a permanent 0% hit ratio that reads as a broken cache
+        caching = use_cache and self.cache.max_bytes > 0
+        if caching:
+            hit = self.cache.lookup(key)
+            if hit is not None:
+                _M_CACHE_HITS.inc()
+                doc = dict(hit)
+                doc["cache"] = "hit"
+                # THIS answer's latency, not the cached miss's —
+                # anyone debugging from the footer would otherwise
+                # read the slow path for a microsecond hit
+                doc["tookMs"] = round(
+                    (time.perf_counter() - t0) * 1000, 3)
+                return doc
+            _M_CACHE_MISSES.inc()
+        stats = {"rowsScanned": 0, "partsScanned": 0, "partsPruned": 0}
+        table_results = [self._execute_table(plan, t, stats)
+                         for t in tables]
+        if len(table_results) == 1:
+            keys, aggs = table_results[0]
+        else:
+            keys, aggs = self._merge_materialized(plan, table_results)
+        if aggs is None or _n_groups(aggs) == 0:
+            rows, groups = empty_result(plan)
+        else:
+            rows, groups = finalize(plan, keys, aggs)
+        took = time.perf_counter() - t0
+        _M_SECONDS.observe(took)
+        _M_ROWS_SCANNED.inc(stats["rowsScanned"])
+        _M_PARTS_SCANNED.inc(stats["partsScanned"])
+        _M_PARTS_PRUNED.inc(stats["partsPruned"])
+        doc = {
+            "plan": plan.to_doc(),
+            "rows": rows,
+            "groupCount": groups,
+            "rowsScanned": stats["rowsScanned"],
+            "partsScanned": stats["partsScanned"],
+            "partsPruned": stats["partsPruned"],
+            "engine": ("parts" if any(
+                getattr(t, "_parts", None) is not None
+                for t in tables) else "flat"),
+            "tookMs": round(took * 1000, 3),
+            "cache": "miss" if caching else "off",
+        }
+        if caching:
+            self.cache.store(key, doc)
+        return doc
+
+    def stats(self) -> Dict[str, object]:
+        """Operator doc for /healthz `query`."""
+        return {
+            "queries": self.queries,
+            "workers": self.workers,
+            "coldBuffer": self.cold_buffer,
+            "kernel": kernels.kernel_mode(),
+            "cache": self.cache.stats(),
+        }
+
+    # -- per-table execution -----------------------------------------------
+
+    def _execute_table(self, plan: QueryPlan, table, stats
+                       ) -> Tuple[Optional[List[np.ndarray]],
+                                  Optional[Dict[str, np.ndarray]]]:
+        """One table → (materialized key columns, merged aggregates)
+        or (None, None) when nothing survives."""
+        if getattr(table, "_parts", None) is None:
+            partial, scanned = self._flat_partial(plan, table)
+            stats["rowsScanned"] += scanned
+        else:
+            partial = self._parts_partials(plan, table, stats)
+        if partial is None:
+            return None, None
+        uniq, aggs = partial
+        keys = materialize_keys(plan, uniq, table.dicts, table.schema)
+        return keys, aggs
+
+    def _flat_partial(self, plan, table) -> Tuple[Partial, int]:
+        """Flat engine: the reference executor over a (column-subset)
+        scan — slow but correct, and the parity anchor."""
+        cols = plan.columns_touched()
+        batch = table.select(columns=cols) if cols else table.scan()
+        return reference_partial(plan, batch, table.dicts), len(batch)
+
+    def _parts_partials(self, plan: QueryPlan, table, stats) -> Partial:
+        """Parts engine: prune → stripe live parts across the worker
+        pool (each worker folds its stripe into one partial
+        accumulator) → evaluate the memtable via the reference path →
+        merge everything exactly."""
+        specs = lower_specs(plan)
+        filters = [_CompiledFilter(f, table) for f in plan.filters]
+        parts, mem = table._snapshot_refs()
+        live = []
+        pruned = 0
+        for p in parts:
+            if not p.overlaps(plan.start, plan.end, plan.time_column,
+                              plan.end_column):
+                pruned += 1
+                continue
+            excluded = False
+            for f in filters:
+                if f.is_string:
+                    # dictionary-code pruning (hot parts: the unique
+                    # code set is resident metadata)
+                    if f.excludes_part(p):
+                        excluded = True
+                        break
+                    continue
+                if f.op == "ne":
+                    continue
+                mm = p.minmax.get(f.column)
+                if mm is not None and _minmax_excludes(
+                        mm, f.op, f.value):
+                    excluded = True
+                    break
+            if excluded:
+                pruned += 1
+            else:
+                live.append(p)
+        partials: List[Partial] = []
+        if live:
+            stripes = [live[i::self.workers]
+                       for i in range(min(self.workers, len(live)))]
+            if len(stripes) == 1:
+                partials.append(self._fold_stripe(
+                    plan, table, specs, filters, stripes[0]))
+            else:
+                pool = get_pool("query", self.workers)
+                futs = [pool.submit(self._fold_stripe, plan, table,
+                                    specs, filters, s)
+                        for s in stripes]
+                partials.extend(f.result() for f in futs)
+        for b in mem:
+            if len(b):
+                partials.append(self._decoded_partial(plan, table,
+                                                      specs, b))
+                stats["rowsScanned"] += len(b)
+        stats["partsScanned"] += len(live)
+        stats["partsPruned"] += pruned
+        stats["rowsScanned"] += sum(p.rows for p in live)
+        merged = kernels.merge_partials(
+            [p for p in partials if p is not None], specs)
+        return merged if len(merged[0]) else None
+
+    def _fold_stripe(self, plan, table, specs, filters,
+                     parts: Sequence) -> Partial:
+        """One worker's stripe: evaluate each part, fold the partials
+        into a single per-worker accumulator."""
+        partials = [self._part_partial(plan, table, specs, filters, p)
+                    for p in parts]
+        partials = [p for p in partials if p is not None]
+        if not partials:
+            return None
+        return kernels.merge_partials(partials, specs)
+
+    # -- per-part evaluation -----------------------------------------------
+
+    def _part_partial(self, plan, table, specs, filters, part
+                      ) -> Partial:
+        chunks = part.chunks
+        if chunks is None:
+            if part.tier == "cold":
+                return self._cold_partial(plan, table, specs, part)
+            # lazy-recovery hot part: decode (and promote) once, then
+            # evaluate in decoded space
+            batch = table._decode_part(part)
+            return self._decoded_partial(plan, table, specs, batch)
+        return self._encoded_partial(plan, table, specs, filters,
+                                     chunks, part.rows)
+
+    def _encoded_partial(self, plan, table, specs, filters,
+                         chunks, n_rows: int) -> Partial:
+        """Hot part, no decode: predicates on width-reduced ints and
+        local dictionary indices; group keys aggregate in local code
+        space; only surviving groups widen to global codes."""
+        mask: object = True
+        if plan.start is not None:
+            mask = _and_mask(mask, _cmp_encoded(
+                chunks[plan.time_column], "ge", plan.start))
+        if mask is not False and plan.end is not None:
+            mask = _and_mask(mask, _cmp_encoded(
+                chunks[plan.end_column], "lt", plan.end))
+        for f in filters:
+            if mask is False:
+                return None
+            chunk = chunks[f.column]
+            if f.is_string:
+                # global code set → positions in the part's unique
+                # codes; an empty intersection decides the part
+                sel = np.zeros(len(chunk.uniq), bool)
+                if len(f.codes):
+                    sel[np.isin(chunk.uniq, f.codes,
+                                assume_unique=True)] = True
+                if f.op == "ne":
+                    if not sel.any():
+                        continue   # nothing excluded
+                    m = ~sel[chunk.local]
+                else:
+                    if not sel.any():
+                        return None   # eq/in can never match here
+                    m = sel[chunk.local]
+                mask = _and_mask(mask, m)
+            else:
+                mask = _and_mask(mask,
+                                 _cmp_encoded(chunk, f.op, f.value))
+        if mask is False:
+            return None
+        full = mask is True
+        if not full and not mask.any():
+            return None
+
+        def masked(arr: np.ndarray) -> np.ndarray:
+            return arr if full else arr[mask]
+
+        # group keys in LOCAL narrow space; remember how to widen the
+        # survivors
+        key_cols: List[np.ndarray] = []
+        widen: List[Tuple[str, object]] = []
+        for name in plan.group_by:
+            chunk = chunks[name]
+            if hasattr(chunk, "uniq"):      # string column
+                key_cols.append(masked(chunk.local).astype(np.int64))
+                widen.append(("uniq", chunk.uniq))
+            else:
+                key_cols.append(masked(chunk.stored).astype(np.int64))
+                widen.append(("base", chunk.base))
+        n_masked = int(n_rows if full else mask.sum())
+        keys = (np.stack(key_cols, axis=1) if key_cols
+                else np.zeros((n_masked, 0), np.int64))
+        values: Dict[str, np.ndarray] = {}
+        for column in value_columns(specs):
+            chunk = chunks[column]
+            arr = masked(chunk.stored).astype(np.int64)
+            if chunk.base:
+                arr += chunk.base
+            values[column] = arr
+        uniq, aggs = kernels.aggregate(keys, values, specs)
+        # late materialization: widen only surviving group keys
+        for j, (kind, aux) in enumerate(widen):
+            if kind == "uniq":
+                uniq[:, j] = aux[uniq[:, j]].astype(np.int64)
+            elif aux:
+                uniq[:, j] += aux
+        return uniq, aggs
+
+    def _cold_partial(self, plan, table, specs, part) -> Partial:
+        """Cold part: stream through the bounded decode buffer,
+        decoding ONLY the plan's columns from the self-contained part
+        file, adopt the subset into table code space, evaluate, drop —
+        the part is never promoted (chunks stay None, tier stays
+        cold)."""
+        # a plan touching NO columns (global count, no filters/window)
+        # still needs the row count — carry one cheap numeric column
+        cols = plan.columns_touched() or (table.schema[0].name,)
+        with self._cold_sem:
+            batch = table._decode_part(part, columns=cols)
+            return self._decoded_partial(plan, table, specs, batch)
+
+    def _decoded_partial(self, plan, table, specs,
+                         batch: ColumnarBatch) -> Partial:
+        """Table-coded batch (memtable, cold subset, lazy part):
+        reference-style mask, kernel aggregation — global code space
+        throughout, so the partial merges directly with the encoded
+        ones."""
+        mask = filter_mask(plan, batch, table.dicts)
+        if not mask.any():
+            return None
+        if plan.group_by:
+            keys = np.stack(
+                [np.asarray(batch[g], np.int64)[mask]
+                 for g in plan.group_by], axis=1)
+        else:
+            keys = np.zeros((int(mask.sum()), 0), np.int64)
+        values = {c: np.asarray(batch[c], np.int64)[mask]
+                  for c in value_columns(specs)}
+        return kernels.aggregate(keys, values, specs)
+
+    # -- cross-table merge (sharded stores) --------------------------------
+
+    def _merge_materialized(self, plan, table_results
+                            ) -> Tuple[Optional[List[np.ndarray]],
+                                       Optional[Dict[str, np.ndarray]]]:
+        """Shards own independent dictionaries, so cross-shard merging
+        happens in MATERIALIZED key space: fold each shard's
+        (decoded keys, aggregates) into one dict keyed by the group
+        tuple."""
+        specs = lower_specs(plan)
+        acc: Dict[tuple, List[int]] = {}
+        for keys, aggs in table_results:
+            if aggs is None:
+                continue
+            g = _n_groups(aggs)
+            for i in range(g):
+                kt = tuple(
+                    (k[i].item() if isinstance(k[i], np.generic)
+                     else k[i]) for k in keys) if keys else ()
+                vals = acc.get(kt)
+                if vals is None:
+                    acc[kt] = [int(aggs[label][i])
+                               for label, _, _ in specs]
+                    continue
+                for j, (label, op, _) in enumerate(specs):
+                    v = int(aggs[label][i])
+                    if kernels.MERGE_OP[op] == "sum":
+                        vals[j] += v
+                    elif kernels.MERGE_OP[op] == "min":
+                        vals[j] = min(vals[j], v)
+                    else:
+                        vals[j] = max(vals[j], v)
+        if not acc:
+            return None, None
+        keys_out: List[np.ndarray] = []
+        ordered = list(acc.keys())
+        for j in range(len(plan.group_by)):
+            vals = [kt[j] for kt in ordered]
+            # numeric group keys must stay int64 — an object array
+            # would make finalize's tie-break compare them as STRINGS
+            # ('80' < '9'), diverging from the single-table engines
+            if all(isinstance(v, (int, np.integer)) for v in vals):
+                keys_out.append(np.asarray(vals, np.int64))
+            else:
+                keys_out.append(np.asarray(vals, dtype=object))
+        aggs_out = {
+            label: np.asarray([acc[kt][j] for kt in ordered], np.int64)
+            for j, (label, _, _) in enumerate(specs)}
+        return keys_out, aggs_out
+
+
+def _n_groups(aggs: Dict[str, np.ndarray]) -> int:
+    return len(next(iter(aggs.values()))) if aggs else 0

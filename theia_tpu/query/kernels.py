@@ -1,0 +1,223 @@
+"""Vectorized group-aggregation kernels for the query engine.
+
+The per-part unit of work is always the same shape: a key matrix
+[n, k] of int64 group keys (dictionary codes / narrow ints already
+widened for the surviving rows) and a set of int64 value columns, in;
+one row per distinct key with count/sum/min/max columns, out. Two
+implementations share that contract:
+
+  * numpy (always available, the canonical semantics): one lexsort
+    over the key columns, group boundaries from adjacent-row
+    comparison, then `ufunc.reduceat` per aggregate — exact int64
+    arithmetic, no Python-object work.
+  * jitted `jnp` segment reductions (`THEIA_QUERY_JAX=auto|1|0`, the
+    THEIA_FUSED_PALLAS discipline): the host still computes the group
+    ids (sorting is host work either way); the per-aggregate segment
+    sums/mins/maxes run as ONE jitted dispatch, with the segment count
+    padded to the next power of two so retrace count stays bounded.
+    `auto` enables it only when JAX runs in x64 mode — without x64 the
+    int64 sums would silently truncate to int32, and the engine's
+    parity contract (bit-identical to the reference executor) is not
+    negotiable. Any runtime failure falls back to numpy for the
+    process, loudly, once.
+
+Merging partials is the same operation: concat the per-part key
+matrices + partial aggregates and re-reduce, with `count` partials
+merged via sum and min/max via min/max.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.logging import get_logger
+
+logger = get_logger("query.kernels")
+
+#: reduction op per aggregate when MERGING partials (count becomes a
+#: sum of partial counts; everything else merges with its own op)
+MERGE_OP = {"count": "sum", "sum": "sum", "min": "min", "max": "max"}
+
+_jax_state_lock = threading.Lock()
+_jax_disabled_reason: Optional[str] = None
+
+
+def kernel_mode() -> str:
+    """'jax' or 'numpy' — what `aggregate()` will use right now, per
+    THEIA_QUERY_JAX (auto|1|0; auto = jax only under x64) and any
+    recorded runtime failure."""
+    raw = os.environ.get("THEIA_QUERY_JAX", "auto").strip().lower()
+    if raw in ("0", "off", "false", "no"):
+        return "numpy"
+    if _jax_disabled_reason is not None:
+        return "numpy"
+    try:
+        import jax
+    except Exception:
+        return "numpy"
+    if raw in ("1", "force", "on", "yes"):
+        return "jax"
+    return "jax" if jax.config.jax_enable_x64 else "numpy"
+
+
+def _disable_jax(reason: str) -> None:
+    global _jax_disabled_reason
+    with _jax_state_lock:
+        if _jax_disabled_reason is None:
+            _jax_disabled_reason = reason
+            logger.error(
+                "query jax kernel disabled for this process "
+                "(falling back to numpy): %s", reason)
+
+
+def group_ids(keys: np.ndarray) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Factorize a key matrix: (order, sorted-group-start offsets,
+    group count). `keys[order]` is lexicographically sorted; group g
+    spans order[starts[g]:starts[g+1]]."""
+    n = keys.shape[0]
+    order = np.lexsort(keys.T[::-1])
+    sk = keys[order]
+    boundary = np.empty(n, dtype=bool)
+    boundary[0] = True
+    boundary[1:] = np.any(sk[1:] != sk[:-1], axis=1)
+    starts = np.flatnonzero(boundary)
+    return order, starts, len(starts)
+
+
+def _reduce_numpy(sorted_vals: Dict[str, np.ndarray],
+                  starts: np.ndarray, n: int,
+                  specs: Sequence[Tuple[str, str, Optional[str]]]
+                  ) -> Dict[str, np.ndarray]:
+    out: Dict[str, np.ndarray] = {}
+    counts: Optional[np.ndarray] = None
+    for label, op, column in specs:
+        if op == "count":
+            if counts is None:
+                counts = np.diff(starts, append=n).astype(np.int64)
+            out[label] = counts
+            continue
+        sv = sorted_vals[column]
+        ufunc = {"sum": np.add, "min": np.minimum,
+                 "max": np.maximum}[op]
+        out[label] = ufunc.reduceat(sv, starts)
+    return out
+
+
+def _reduce_jax(gids: np.ndarray, n_groups: int,
+                sorted_vals: Dict[str, np.ndarray],
+                specs: Sequence[Tuple[str, str, Optional[str]]]
+                ) -> Dict[str, np.ndarray]:
+    """One jitted dispatch covering every aggregate. Segment count is
+    padded to the next power of two so the jit cache stays small; the
+    pad groups are sliced off on the way out."""
+    import jax
+
+    padded = 1 << max(int(n_groups) - 1, 0).bit_length()
+    ops = tuple((op, column) for _, op, column in specs)
+    names = tuple(sorted({c for _, c in ops if c is not None}))
+    vals = [sorted_vals[c] for c in names]
+    results = _jax_segment_reduce(
+        tuple(ops), names, jax.numpy.asarray(gids), padded, *vals)
+    out: Dict[str, np.ndarray] = {}
+    for (label, _, _), r in zip(specs, results):
+        out[label] = np.asarray(r)[:n_groups]
+    return out
+
+
+_jax_fns: Dict[tuple, object] = {}
+
+
+def _jax_segment_reduce(ops, names, gids, num_segments, *vals):
+    """Dispatch through a per-(ops, names) jitted closure so
+    `num_segments` stays a static arg (padded upstream)."""
+    import jax
+    import jax.numpy as jnp
+
+    key = (ops, names)
+    fn = _jax_fns.get(key)
+    if fn is None:
+        def body(gids, num_segments, *vals):
+            cols = dict(zip(names, vals))
+            outs = []
+            for op, column in ops:
+                if op == "count":
+                    outs.append(jax.ops.segment_sum(
+                        jnp.ones_like(gids), gids,
+                        num_segments=num_segments))
+                elif op == "sum":
+                    outs.append(jax.ops.segment_sum(
+                        cols[column], gids,
+                        num_segments=num_segments))
+                elif op == "min":
+                    outs.append(jax.ops.segment_min(
+                        cols[column], gids,
+                        num_segments=num_segments))
+                else:
+                    outs.append(jax.ops.segment_max(
+                        cols[column], gids,
+                        num_segments=num_segments))
+            return tuple(outs)
+
+        fn = _jax_fns[key] = jax.jit(
+            body, static_argnames=("num_segments",))
+    return fn(gids, num_segments, *vals)
+
+
+def aggregate(keys: np.ndarray, values: Dict[str, np.ndarray],
+              specs: Sequence[Tuple[str, str, Optional[str]]]
+              ) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
+    """GROUP BY `keys` ([n, k] int64) computing every spec
+    (label, op, column) over int64 `values`. Returns (unique keys
+    [g, k] in lexicographic order, {label: [g] int64}).
+
+    `n == 0` returns empty outputs; `k == 0` (global aggregate)
+    reduces everything into one group."""
+    n = keys.shape[0]
+    if n == 0:
+        return (keys.reshape(0, keys.shape[1]),
+                {label: np.zeros(0, np.int64) for label, _, _ in specs})
+    if keys.shape[1] == 0:
+        order = np.arange(n)
+        starts = np.zeros(1, np.int64)
+    else:
+        order, starts, _ = group_ids(keys)
+    sorted_vals = {c: np.ascontiguousarray(v[order])
+                   for c, v in values.items()}
+    uniq = keys[order][starts]
+    if kernel_mode() == "jax":
+        try:
+            gids = np.zeros(n, np.int64)
+            gids[starts[1:]] = 1
+            gids = np.cumsum(gids)
+            return uniq, _reduce_jax(gids, len(starts), sorted_vals,
+                                     specs)
+        except Exception as e:   # pragma: no cover - env dependent
+            _disable_jax(f"{type(e).__name__}: {e}")
+    return uniq, _reduce_numpy(sorted_vals, starts, n, specs)
+
+
+def merge_partials(partials: Sequence[
+        Tuple[np.ndarray, Dict[str, np.ndarray]]],
+        specs: Sequence[Tuple[str, str, Optional[str]]]
+        ) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
+    """Combine per-part partial aggregates: concat their (keys, aggs)
+    and re-reduce with each aggregate's MERGE op (partial counts sum;
+    partial mins min; ...). Key spaces must be comparable (same table
+    dictionary) — cross-table merges materialize first."""
+    live = [p for p in partials if p is not None and len(p[0])]
+    if not live:
+        k = partials[0][0].shape[1] if partials else 0
+        return (np.zeros((0, k), np.int64),
+                {label: np.zeros(0, np.int64) for label, _, _ in specs})
+    if len(live) == 1:
+        return live[0]
+    keys = np.concatenate([p[0] for p in live])
+    merge_specs = [(label, MERGE_OP[op], label)
+                   for label, op, _ in specs]
+    values = {label: np.concatenate([p[1][label] for p in live])
+              for label, _, _ in specs}
+    return aggregate(keys, values, merge_specs)

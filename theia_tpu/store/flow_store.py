@@ -161,12 +161,18 @@ class Table:
         return sum(v.nbytes for b in self._batches
                    for v in b.columns.values())
 
-    def _adopt(self, batch: ColumnarBatch) -> ColumnarBatch:
+    def _adopt(self, batch: ColumnarBatch,
+               columns: Optional[Sequence[str]] = None
+               ) -> ColumnarBatch:
         """Re-encode a batch against this table's dictionaries
         (cached incremental mappings: amortized O(new dict entries)
-        per block, not O(dictionary))."""
+        per block, not O(dictionary)). `columns` adopts only that
+        subset (the column-subset cold-part decode path — the batch
+        then carries just those columns)."""
         cols: Dict[str, np.ndarray] = {}
         for col in self.schema:
+            if columns is not None and col.name not in columns:
+                continue
             arr = batch[col.name]
             if col.is_string:
                 src = batch.dicts.get(col.name)
@@ -275,18 +281,25 @@ class Table:
     def select(self, start_time: Optional[int] = None,
                end_time: Optional[int] = None,
                time_column: str = "flowStartSeconds",
-               end_column: str = "flowEndSeconds") -> ColumnarBatch:
+               end_column: str = "flowEndSeconds",
+               columns: Optional[Sequence[str]] = None
+               ) -> ColumnarBatch:
         """Time-window select, mirroring the jobs' SQL predicates
         (`flowStartSeconds >= start AND flowEndSeconds < end`, reference
-        policy_recommendation_job.py:796-798)."""
+        policy_recommendation_job.py:796-798). `columns` projects the
+        result to that subset (the window mask still evaluates on the
+        full time columns) — the flat half of the parts engine's
+        column-subset read path, so query callers are engine-agnostic."""
         data = self.scan()
         if start_time is None and end_time is None:
-            return data
+            return data if columns is None else data.select(columns)
         mask = np.ones(len(data), dtype=bool)
         if start_time is not None:
             mask &= data[time_column] >= start_time
         if end_time is not None:
             mask &= data[end_column] < end_time
+        if columns is not None:
+            data = data.select(columns)
         return data.filter(mask)
 
     def delete_where(self, mask: np.ndarray) -> int:

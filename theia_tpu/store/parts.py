@@ -63,6 +63,7 @@ Env knobs (all also constructor-injectable for tests):
 from __future__ import annotations
 
 import contextlib
+import itertools
 import json
 import os
 import struct
@@ -243,10 +244,17 @@ def write_part_file(path: str, table: str,
     return _PART_HEADER.size + body_len
 
 
-def read_part_file(path: str) -> ColumnarBatch:
+def read_part_file(path: str,
+                   columns: Optional[Sequence[str]] = None
+                   ) -> ColumnarBatch:
     """Decode one part file (verifying the checksum) into a batch with
     fresh per-file dictionaries — the caller adopts it into table code
-    space. Raises PartsError on any structural damage."""
+    space. Raises PartsError on any structural damage.
+
+    `columns` restricts the decode to that subset: the other columns'
+    byte ranges are skipped on disk (wal.decode_record_body) — the
+    cold-tier read path for queries that touch a handful of the 52
+    columns."""
     try:
         with open(path, "rb") as f:
             data = f.read()
@@ -267,7 +275,8 @@ def read_part_file(path: str) -> ColumnarBatch:
     if crc_fn is not None and (crc_fn(body, 0) & 0xFFFFFFFF) != crc:
         raise PartsError(f"part {path}: checksum mismatch")
     try:
-        _, batch = _wal.decode_record_body(body)
+        _, batch = _wal.decode_record_body(
+            body, None if columns is None else frozenset(columns))
     except _wal.WalCorruption as e:
         raise PartsError(f"part {path}: {e}")
     return batch
@@ -275,18 +284,25 @@ def read_part_file(path: str) -> ColumnarBatch:
 
 # -- parts ----------------------------------------------------------------
 
+#: process-unique Part identities — the query-result cache fingerprints
+#: the part SET with these (seal/merge/delete mint new Part objects,
+#: demote flips the tier; either moves the fingerprint)
+_part_uid = itertools.count(1)
+
+
 class Part:
     """One immutable sealed part: row count + min/max pruning metadata
     always resident; column chunks resident on the hot tier, decoded
     on demand from the self-contained file on the cold tier."""
 
     __slots__ = ("rows", "minmax", "chunks", "path", "tier",
-                 "file_bytes", "raw_bytes")
+                 "file_bytes", "raw_bytes", "uid")
 
     def __init__(self, rows: int, minmax: Dict[str, Tuple[int, int]],
                  chunks: Optional[Dict[str, object]],
                  path: Optional[str] = None, tier: str = "hot",
                  file_bytes: int = 0, raw_bytes: int = 0) -> None:
+        self.uid = next(_part_uid)
         self.rows = rows
         self.minmax = minmax
         self.chunks = chunks
@@ -380,6 +396,7 @@ class PartTable(Table):
         self._memtable_len = 0
         self.parts_sealed = 0
         self.parts_merged = 0
+        self.parts_merged_cold = 0
         self.parts_demoted = 0
         self.manifest_generation = 0
         #: part files written since the last manifest publish (fsynced
@@ -395,6 +412,12 @@ class PartTable(Table):
         #: the GC keep-set includes them so a concurrent save cannot
         #: collect a file mid-creation
         self._gc_guard: set = set()
+        #: two-phase GC for never-published tables: files found
+        #: unreferenced by one maintenance pass are only unlinked by
+        #: the NEXT pass, so a reader that snapshotted parts just
+        #: before a cold merge retired them keeps at least one full
+        #: maintenance interval to finish streaming their files
+        self._gc_candidates: set = set()
         #: basenames captured by an in-flight snapshot's manifest
         #: entries (set at capture, rolled into _manifest_files at
         #: publish) — the maintenance GC must not collect a file the
@@ -492,13 +515,17 @@ class PartTable(Table):
             _M_SEALED.inc()
 
     def _build_part(self, batch: ColumnarBatch,
-                    write_file: bool = True) -> Part:
+                    write_file: bool = True,
+                    resident: bool = True) -> Part:
         """Seal one adopted batch into a Part. `write_file=False`
         skips the on-disk copy — the delete paths rewrite parts while
         HOLDING the table lock, and disk I/O there would stall the
         ingest hot path; the next snapshot materializes missing files
-        outside the lock (snapshot_parts_state)."""
-        chunks = _encode_chunks(self.schema, self.dicts, batch)
+        outside the lock (snapshot_parts_state). `resident=False`
+        skips the in-RAM chunk encode — the cold-merge path, whose
+        product goes straight to disk."""
+        chunks = (_encode_chunks(self.schema, self.dicts, batch)
+                  if resident else None)
         minmax = _minmax_of(batch, self._prune_columns)
         raw = sum(a.nbytes for a in batch.columns.values())
         path = None
@@ -539,22 +566,35 @@ class PartTable(Table):
 
     # -- decode ------------------------------------------------------------
 
-    def _decode_part(self, part: Part) -> ColumnarBatch:
+    def _decode_part(self, part: Part,
+                     columns: Optional[Sequence[str]] = None
+                     ) -> ColumnarBatch:
         """Part → ColumnarBatch in table code space. Hot parts gather
         from resident chunks; tier-'hot' parts without chunks (lazy
         manifest recovery) decode their file once and promote; cold
-        parts decode on demand and stay cold."""
+        parts decode on demand and stay cold.
+
+        `columns` restricts the decode to that subset: resident
+        chunks gather only those columns, and a FILE decode skips the
+        other columns' bytes on disk. A subset decode NEVER promotes
+        (promotion needs every column) — a lazy hot part stays lazy,
+        a cold part stays cold, which is exactly what a query that
+        touches 4 of 52 columns wants."""
         chunks = part.chunks
         if chunks is not None:
+            if columns is not None:
+                return ColumnarBatch(
+                    {n: chunks[n].decode() for n in columns},
+                    self.dicts)
             return ColumnarBatch(
                 {n: c.decode() for n, c in chunks.items()}, self.dicts)
         if part.path is None:
             raise PartsError(
                 f"part of {self.name} has neither resident chunks nor "
                 f"a file (corrupted state)")
-        raw = read_part_file(part.path)
-        adopted = self._adopt(raw)
-        if part.tier == "hot":
+        raw = read_part_file(part.path, columns=columns)
+        adopted = self._adopt(raw, columns=columns)
+        if part.tier == "hot" and columns is None:
             part.chunks = _encode_chunks(self.schema, self.dicts,
                                          adopted)
         return adopted
@@ -582,12 +622,26 @@ class PartTable(Table):
     def select(self, start_time: Optional[int] = None,
                end_time: Optional[int] = None,
                time_column: str = "flowStartSeconds",
-               end_column: str = "flowEndSeconds") -> ColumnarBatch:
+               end_column: str = "flowEndSeconds",
+               columns: Optional[Sequence[str]] = None
+               ) -> ColumnarBatch:
         """Time-window select decoding ONLY parts whose min/max range
         overlaps the window — the pruned read path that makes keeping
-        analytics in the store affordable."""
-        if start_time is None and end_time is None:
+        analytics in the store affordable. `columns` projects the
+        result to that subset AND pushes the projection into the part
+        decode: a pruned select over cold parts reads only those
+        columns' bytes from disk (the window columns ride along for
+        the mask, then drop out of the result)."""
+        if start_time is None and end_time is None and columns is None:
             return self.scan()
+        decode_cols = None
+        if columns is not None:
+            decode_cols = list(columns)
+            for c in ((time_column,) if start_time is not None else ()
+                      ) + ((end_column,) if end_time is not None
+                           else ()):
+                if c not in decode_cols:
+                    decode_cols.append(c)
         parts, mem = self._snapshot_refs()
         live = [p for p in parts
                 if p.overlaps(start_time, end_time, time_column,
@@ -596,7 +650,11 @@ class PartTable(Table):
         if live:
             _M_SCANNED.inc(len(live))
         out: List[ColumnarBatch] = []
-        for batch in ([self._decode_part(p) for p in live] + mem):
+        decoded = [self._decode_part(p, columns=decode_cols)
+                   for p in live]
+        if columns is not None:
+            mem = [b.select(decode_cols) for b in mem]
+        for batch in (decoded + mem):
             if not len(batch):
                 continue
             mask = np.ones(len(batch), dtype=bool)
@@ -604,11 +662,15 @@ class PartTable(Table):
                 mask &= batch[time_column] >= start_time
             if end_time is not None:
                 mask &= batch[end_column] < end_time
+            if columns is not None:
+                batch = batch.select(columns)
             out.append(batch if mask.all() else batch.filter(mask))
         if not out:
+            schema = (self.schema if columns is None else
+                      [c for c in self.schema if c.name in columns])
             return ColumnarBatch(
                 {c.name: np.zeros(0, c.host_dtype)
-                 for c in self.schema}, self.dicts)
+                 for c in schema}, self.dicts)
         return out[0] if len(out) == 1 else ColumnarBatch.concat(out)
 
     # -- deletes -----------------------------------------------------------
@@ -633,9 +695,9 @@ class PartTable(Table):
         resident and fileless until maintenance/snapshot materializes
         them outside the lock."""
         if old.tier == "cold" and self.directory:
-            part = self._build_part(keep, write_file=True)
+            part = self._build_part(keep, write_file=True,
+                                    resident=False)
             part.tier = "cold"
-            part.chunks = None
             return part
         return self._build_part(keep, write_file=False)
 
@@ -757,9 +819,12 @@ class PartTable(Table):
         d = self.dicts[column]
         deleted = 0
         with self._lock:
-            codes = np.asarray(sorted(
-                c for c in (d.lookup(str(s)) for s in ids)
-                if c is not None), np.int32)
+            # unique, not just sorted: the per-part unique-code
+            # intersection below passes assume_unique=True, and the
+            # caller's id list may repeat
+            codes = np.unique(np.asarray(
+                [c for c in (d.lookup(str(s)) for s in ids)
+                 if c is not None], np.int32))
             if not len(codes) and not invert:
                 return 0
             rewrites: List[Tuple[int, Optional[ColumnarBatch]]] = []
@@ -879,8 +944,12 @@ class PartTable(Table):
                 if resident - freed <= target_bytes:
                     break
                 freed += part.nbytes
-                part.chunks = None
+                # tier BEFORE chunks: a lock-free reader (the query
+                # engine) that observes chunks=None must also observe
+                # tier=cold, or it would take the lazy-hot decode
+                # path and promote the part we just demoted
                 part.tier = "cold"
+                part.chunks = None
                 self.parts_demoted += 1
                 _M_DEMOTED.inc()
         return freed
@@ -888,9 +957,10 @@ class PartTable(Table):
     # -- background compaction ---------------------------------------------
 
     def maintain(self) -> int:
-        """One maintenance pass: merge runs of ADJACENT small hot
-        parts in the same time partition (adjacency preserves global
-        insertion order), materialize files for delete-rewritten
+        """One maintenance pass: merge runs of ADJACENT small parts in
+        the same time partition (adjacency preserves global insertion
+        order) — hot runs in RAM, cold runs on disk without
+        re-promotion — materialize files for delete-rewritten
         parts, and — for tables that never publish a manifest
         (sharded/replicated shards, whose wholesale snapshots don't
         consult part files) — collect unreferenced files, which would
@@ -911,40 +981,63 @@ class PartTable(Table):
 
     def _merge_pass(self) -> int:
         merges = 0
-        while True:
-            run = self._find_merge_run()
-            if run is None:
-                break
-            refs = run
-            # decode + re-encode OUTSIDE the lock (parts are
-            # immutable); swap in only if the run is still intact
-            merged = ColumnarBatch.concat(
-                [self._decode_part(p) for p in refs])
-            new_part = self._build_part(merged)
-            with self._lock:
-                try:
-                    i = self._parts.index(refs[0])
-                except ValueError:
-                    i = -1
-                intact = (i >= 0 and
-                          self._parts[i:i + len(refs)] == refs)
-                if intact:
-                    self._parts[i:i + len(refs)] = [new_part]
-            if not intact:
-                # a concurrent delete rewrote the run — drop our
-                # merged part; the next maintenance pass retries
-                # (bailing here keeps a delete-heavy phase from
-                # pinning this pass in a rebuild loop)
-                self._retire_file(new_part)
-                break
-            for p in refs:
-                self._retire_file(p)
-            merges += 1
-            self.parts_merged += 1
-            _M_MERGES.inc()
+        for tier in ("hot", "cold"):
+            if tier == "cold" and not self.directory:
+                continue   # cold parts live in files — nothing to do
+            while True:
+                run = self._find_merge_run(tier)
+                if run is None:
+                    break
+                if self._merge_run(run, tier):
+                    merges += 1
+                else:
+                    break
         return merges
 
-    def _find_merge_run(self) -> Optional[List[Part]]:
+    def _merge_run(self, refs: List[Part], tier: str) -> bool:
+        """Compact one run into a single part of the SAME tier. A cold
+        run's replacement is written straight to disk and registered
+        cold (chunks None) — a long-retention tier coalesces its tiny
+        files WITHOUT re-promoting a byte into RAM; the source parts'
+        transient decode is bounded by the run's row budget."""
+        # decode + re-encode OUTSIDE the lock (parts are immutable);
+        # swap in only if the run is still intact
+        merged = ColumnarBatch.concat(
+            [self._decode_part(p) for p in refs])
+        new_part = self._build_part(merged, resident=(tier == "hot"))
+        if tier == "cold":
+            new_part.tier = "cold"
+        with self._lock:
+            try:
+                i = self._parts.index(refs[0])
+            except ValueError:
+                i = -1
+            intact = (i >= 0 and
+                      self._parts[i:i + len(refs)] == refs)
+            if intact:
+                self._parts[i:i + len(refs)] = [new_part]
+        if not intact:
+            # a concurrent delete rewrote the run — drop our merged
+            # part; the next maintenance pass retries (bailing here
+            # keeps a delete-heavy phase from pinning this pass in a
+            # rebuild loop)
+            self._retire_file(new_part)
+            return False
+        for p in refs:
+            self._retire_file(p)
+        self.parts_merged += 1
+        if tier == "cold":
+            self.parts_merged_cold += 1
+        _M_MERGES.inc()
+        return True
+
+    def _find_merge_run(self, tier: str = "hot"
+                        ) -> Optional[List[Part]]:
+        """Leftmost run of >= 2 ADJACENT small same-partition parts of
+        `tier` (adjacency preserves global insertion order). Hot runs
+        compact resident chunks; cold runs compact the on-disk files a
+        long-retention tier otherwise accumulates one tiny demotion at
+        a time."""
         col = self.part_time_column
         with self._lock:
             small = self.part_rows // 2
@@ -959,8 +1052,10 @@ class PartTable(Table):
             run: List[Part] = []
             total = 0
             for p in self._parts:
-                mergeable = (p.tier == "hot" and p.rows < small
-                             and pkey(p) is not None)
+                mergeable = (p.tier == tier and p.rows < small
+                             and pkey(p) is not None
+                             and (tier == "hot"
+                                  or p.path is not None))
                 if (mergeable and run
                         and pkey(p) == pkey(run[0])
                         and total + p.rows <= self.part_rows):
@@ -1172,9 +1267,31 @@ class PartTable(Table):
         (part files are a cold-tier cache only, never a recovery
         source): retired files — including their never-to-be-drained
         pending-fsync entries — collect here, since the publish-time
-        GC never runs."""
+        GC never runs. TWO-PHASE: a file is unlinked only once two
+        consecutive passes found it unreferenced — a query that
+        snapshotted the part list just before a cold merge retired a
+        run must be able to finish streaming those files (readers are
+        lock-free and hold no leases; one maintenance interval is the
+        grace window)."""
         keep = self._gc_keep_set(include_pending=False)
-        removed = self._unlink_except(keep)
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return 0
+        unref = {n for n in names
+                 if n.startswith("part-") and n.endswith(".tprt")
+                 and n not in keep}
+        doomed = unref & self._gc_candidates
+        self._gc_candidates = unref - doomed
+        removed = 0
+        for name in doomed:
+            with contextlib.suppress(OSError):
+                os.unlink(os.path.join(self.directory, name))
+                removed += 1
+        if removed:
+            logger.v(1).info(
+                "parts gc removed %d unreferenced part files under "
+                "%s", removed, self.directory)
         with self._fsync_lock:
             self._pending_fsync = [
                 p for p in self._pending_fsync
@@ -1236,6 +1353,7 @@ class PartTable(Table):
             "memtableBytes": mem_bytes,
             "sealed": self.parts_sealed,
             "merges": self.parts_merged,
+            "coldMerges": self.parts_merged_cold,
             "demoted": self.parts_demoted,
             "generation": self.manifest_generation,
             "directory": self.directory,

@@ -337,19 +337,29 @@ def encode_record_parts(table: str, batch: ColumnarBatch
     return parts
 
 
-def decode_record_body(body: bytes) -> Tuple[str, ColumnarBatch]:
+def decode_record_body(body: bytes,
+                       columns: Optional[frozenset] = None
+                       ) -> Tuple[str, ColumnarBatch]:
     """Inverse of `encode_record_parts`: (table, batch with fresh
     per-record dictionaries). Raises WalCorruption on structural
-    damage (the caller decides whether to drop or abort)."""
+    damage (the caller decides whether to drop or abort).
+
+    `columns` restricts decoding to that column subset: the byte
+    ranges of every other column are SKIPPED — no array construction,
+    no string decode — which is what makes a cold part file cheap to
+    query when the plan touches 4 of the 52 columns. Framing is still
+    fully walked, so a truncated/corrupt record raises either way."""
     try:
-        return _decode_record_body(body)
+        return _decode_record_body(body, columns)
     except WalCorruption:
         raise
     except Exception as e:
         raise WalCorruption(f"undecodable WAL record: {e}")
 
 
-def _decode_record_body(body: bytes) -> Tuple[str, ColumnarBatch]:
+def _decode_record_body(body: bytes,
+                        columns: Optional[frozenset] = None
+                        ) -> Tuple[str, ColumnarBatch]:
     mv = memoryview(body)
     (tlen,) = struct.unpack_from("<H", mv, 0)
     off = 2
@@ -366,10 +376,17 @@ def _decode_record_body(body: bytes) -> Tuple[str, ColumnarBatch]:
         off += nlen
         (kind,) = struct.unpack_from("<B", mv, off)
         off += 1
+        wanted = columns is None or name in columns
         if kind == 1:
             n_uniq, blob_len, code_size = struct.unpack_from(
                 "<IIB", mv, off)
             off += 9
+            if not wanted:
+                if code_size not in (1, 2, 4):
+                    raise WalCorruption(
+                        f"bad string code itemsize {code_size}")
+                off += 4 * n_uniq + blob_len + code_size * n_rows
+                continue
             lens = np.frombuffer(mv, "<i4", count=n_uniq, offset=off)
             off += 4 * n_uniq
             blob = bytes(mv[off:off + blob_len])
@@ -405,6 +422,9 @@ def _decode_record_body(body: bytes) -> Tuple[str, ColumnarBatch]:
             off += slen
             base, rlen = struct.unpack_from("<qI", mv, off)
             off += 12
+            if not wanted:
+                off += rlen
+                continue
             arr = np.frombuffer(mv, stored_dt, count=n_rows,
                                 offset=off)
             arr = arr.astype(dtype) if stored_dt != dtype \
