@@ -1392,6 +1392,198 @@ def run_benchmarks() -> dict:
         print(f"overload bench skipped: {e}", file=sys.stderr)
         traceback.print_exc(file=sys.stderr)
 
+    # Cluster tier (docs/cluster.md) through REAL managers on
+    # ephemeral ports: (1) WAL log-shipping replication throughput
+    # with quorum vs leader-only acks, behind a CONSERVATION gate —
+    # every row the producer was acknowledged for must be on the
+    # follower; (2) failover: kill -9 the leader, promote the
+    # follower, measure wall time until the producer's next ack on
+    # the new leader, gated on zero acked-row loss + dedup-resolved
+    # duplicates; (3) router forward rate on a 2-peer mesh, gated on
+    # cluster-wide row conservation. THEIA_BENCH_FAST shrinks the
+    # block counts to a smoke.
+    cluster_bench: dict = {}
+    try:
+        import json as _cj
+        import shutil as _cshutil
+        import socket as _csocket
+        import tempfile as _ctempfile
+        import urllib.request as _curlreq
+
+        from theia_tpu.ingest import BlockEncoder as _ClEnc
+        from theia_tpu.ingest.client import IngestClient as _ClClient
+        from theia_tpu.manager import TheiaManagerServer as _ClSrv
+        from theia_tpu.store import FlowDatabase as _ClDb
+
+        def _cl_port():
+            s = _csocket.socket()
+            s.bind(("127.0.0.1", 0))
+            p = s.getsockname()[1]
+            s.close()
+            return p
+
+        fastc = os.environ.get("THEIA_BENCH_FAST") == "1"
+        n_blocks = 3 if fastc else 30
+        saved_env_c = {k: os.environ.get(k) for k in
+                       ("THEIA_RETENTION_INTERVAL",)}
+        os.environ["THEIA_RETENTION_INTERVAL"] = "0"
+        tmpc = _ctempfile.mkdtemp(prefix="theia-cluster-bench-")
+        try:
+            # -- replication: quorum vs leader acks ------------------
+            for policy in ("quorum", "leader"):
+                p0, p1 = _cl_port(), _cl_port()
+                peers = (f"n0=http://127.0.0.1:{p0},"
+                         f"n1=http://127.0.0.1:{p1}")
+                db0 = _ClDb()
+                db0.attach_wal(os.path.join(tmpc, f"{policy}-w0"))
+                db1 = _ClDb()
+                db1.attach_wal(os.path.join(tmpc, f"{policy}-w1"))
+                lead = _ClSrv(db0, port=p0, cluster_peers=peers,
+                              cluster_self="n0", cluster_role="leader",
+                              cluster_acks=policy)
+                fol = _ClSrv(db1, port=p1, cluster_peers=peers,
+                             cluster_self="n1",
+                             cluster_role="follower")
+                lead.start_background()
+                fol.start_background()
+                try:
+                    enc = _ClEnc()
+                    blk = generate_flows(SynthConfig(
+                        n_series=200, points_per_series=10, seed=31),
+                        dicts=enc.dicts)
+                    cl = _ClClient(f"http://127.0.0.1:{p0}",
+                                   stream=f"repl-{policy}")
+                    cl.send(enc.encode(blk))   # jit warm, untimed
+                    t0c = time.perf_counter()
+                    for _ in range(n_blocks):
+                        cl.send(enc.encode(blk))
+                    dt_c = time.perf_counter() - t0c
+                    if policy == "leader":
+                        # leader-only acks ship async: wait for drain
+                        deadline = time.monotonic() + 30
+                        while time.monotonic() < deadline and \
+                                len(db1.flows) != len(db0.flows):
+                            time.sleep(0.02)
+                    conserved = (len(db1.flows) == len(db0.flows)
+                                 == cl.rows_acked)
+                    cluster_bench[
+                        f"repl_ship_rows_per_sec_{policy}"] = round(
+                        (n_blocks * len(blk)) / dt_c)
+                    ok_key = "repl_conservation_ok"
+                    cluster_bench[ok_key] = (
+                        cluster_bench.get(ok_key, True) and conserved)
+                    if not conserved:
+                        print(f"replication CONSERVATION FAILED "
+                              f"({policy}): leader {len(db0.flows)} "
+                              f"follower {len(db1.flows)} acked "
+                              f"{cl.rows_acked}", file=sys.stderr)
+                finally:
+                    lead.shutdown()
+                    fol.shutdown()
+
+            # -- failover recovery time ------------------------------
+            p0, p1 = _cl_port(), _cl_port()
+            peers = (f"n0=http://127.0.0.1:{p0},"
+                     f"n1=http://127.0.0.1:{p1}")
+            db0 = _ClDb()
+            db0.attach_wal(os.path.join(tmpc, "fo-w0"))
+            db1 = _ClDb()
+            db1.attach_wal(os.path.join(tmpc, "fo-w1"))
+            lead = _ClSrv(db0, port=p0, cluster_peers=peers,
+                          cluster_self="n0", cluster_role="leader",
+                          cluster_acks="quorum")
+            fol = _ClSrv(db1, port=p1, cluster_peers=peers,
+                         cluster_self="n1", cluster_role="follower")
+            lead.start_background()
+            fol.start_background()
+            try:
+                enc = _ClEnc()
+                blk = generate_flows(SynthConfig(
+                    n_series=200, points_per_series=10, seed=32),
+                    dicts=enc.dicts)
+                cl = _ClClient(
+                    [f"http://127.0.0.1:{p0}",
+                     f"http://127.0.0.1:{p1}"], stream="fo",
+                    max_attempts=60, backoff_base=0.02,
+                    backoff_cap=0.2)
+                for _ in range(3 if fastc else 6):
+                    cl.send(enc.encode(blk))
+                acked_before = cl.rows_acked
+                t0f = time.perf_counter()
+                lead.httpd.shutdown()          # kill -9 equivalence:
+                lead.httpd.server_close()      # no drain, no close
+                lead.cluster.stop()
+                req = _curlreq.Request(
+                    f"http://127.0.0.1:{p1}/cluster/promote",
+                    data=_cj.dumps(
+                        {"atLsn": db1.wal_position()}).encode(),
+                    method="POST")
+                with _curlreq.urlopen(req, timeout=30) as r:
+                    r.read()
+                # the producer retries its LAST acked batch (the one
+                # whose ack could have been lost on the wire), then
+                # resumes with a fresh encoder chain on the new leader
+                dup = cl.send(b"\x00", seq=cl.seq)
+                enc2 = _ClEnc()
+                blk2 = generate_flows(SynthConfig(
+                    n_series=200, points_per_series=10, seed=33),
+                    dicts=enc2.dicts)
+                cl.send(enc2.encode(blk2))
+                dt_fo = time.perf_counter() - t0f
+                cluster_bench["failover_recovery_seconds"] = round(
+                    dt_fo, 3)
+                cluster_bench["failover_conservation_ok"] = bool(
+                    dup.get("duplicate")
+                    and len(db1.flows) == acked_before + len(blk2))
+            finally:
+                fol.shutdown()
+
+            # -- router forwarding -----------------------------------
+            p0, p1 = _cl_port(), _cl_port()
+            peers = (f"n0=http://127.0.0.1:{p0},"
+                     f"n1=http://127.0.0.1:{p1}")
+            db0, db1 = _ClDb(), _ClDb()
+            s0 = _ClSrv(db0, port=p0, cluster_peers=peers,
+                        cluster_self="n0", cluster_role="peer")
+            s1 = _ClSrv(db1, port=p1, cluster_peers=peers,
+                        cluster_self="n1", cluster_role="peer")
+            s0.start_background()
+            s1.start_background()
+            try:
+                enc = _ClEnc()
+                blk = generate_flows(SynthConfig(
+                    n_series=200, points_per_series=10, seed=34),
+                    dicts=enc.dicts)
+                cl = _ClClient(f"http://127.0.0.1:{p0}",
+                               stream="mesh")
+                cl.send(enc.encode(blk))   # warm both nodes' jit
+                t0r = time.perf_counter()
+                for _ in range(n_blocks):
+                    cl.send(enc.encode(blk))
+                dt_r = time.perf_counter() - t0r
+                cluster_bench["router_forward_rows_per_sec"] = round(
+                    (n_blocks * len(blk)) / dt_r)
+                cluster_bench["router_conservation_ok"] = (
+                    len(db0.flows) + len(db1.flows) == cl.rows_acked)
+            finally:
+                s0.shutdown()
+                s1.shutdown()
+            print("cluster: " + ", ".join(
+                f"{k.replace('repl_', '').replace('router_', 'router ')}"
+                f" {v:,}" if isinstance(v, int) else f"{k} {v}"
+                for k, v in cluster_bench.items()), file=sys.stderr)
+        finally:
+            for k, v in saved_env_c.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            _cshutil.rmtree(tmpc, ignore_errors=True)
+    except Exception as e:
+        import traceback
+        print(f"cluster bench skipped: {e}", file=sys.stderr)
+        traceback.print_exc(file=sys.stderr)
+
     try:
         import contextlib
 
@@ -1450,6 +1642,8 @@ def run_benchmarks() -> dict:
         result.update(query_bench)
     if overload:
         result.update(overload)
+    if cluster_bench:
+        result.update(cluster_bench)
     if fused_parity_ok is not None:
         result["fused_parity_ok"] = fused_parity_ok
     if fused_det_rate:

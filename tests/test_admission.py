@@ -274,6 +274,40 @@ def test_dedup_window_bounds_streams():
     assert w.lookup("s5", 1) == 1
 
 
+def test_dedup_window_stream_cardinality_bounded():
+    """ROADMAP item-5 pre-work regression: ~100k DISTINCT stream ids
+    (a router mesh's per-origin sub-streams, a producer fleet minting
+    ids) must hold the stream LRU at its cap, keep the GLOBAL entry
+    budget, and keep the running entry count exact — all O(1) per op
+    (this loop is ~100k records; an O(streams) stats() or eviction
+    would blow the test budget immediately)."""
+    w = DedupWindow(window=4, max_streams=1000, max_entries=2500)
+    n = 100_000
+    for i in range(n):
+        w.record(f"s{i}", 1, i)
+        if i % 10_000 == 0:
+            st = w.stats()   # O(1): running counters, no walk
+            assert st["streams"] <= 1000
+            assert st["entries"] <= 2500
+    st = w.stats()
+    assert st["streams"] <= 1000
+    assert st["entries"] == sum(
+        len(win) for win in w._streams.values())   # exact accounting
+    assert st["evictedStreams"] == n - st["streams"]
+    # the newest streams are still answerable; ancient ones aged out
+    assert w.lookup(f"s{n - 1}", 1) == n - 1
+    assert w.lookup("s0", 1) is None
+    # the global ENTRY budget evicts whole cold streams even when the
+    # stream cap alone would admit them
+    w2 = DedupWindow(window=1000, max_streams=1000, max_entries=100)
+    for i in range(50):
+        for seq in range(10):
+            w2.record(f"t{i}", seq, 1)
+    st2 = w2.stats()
+    assert st2["entries"] <= 100
+    assert w2.lookup("t49", 9) == 1
+
+
 def test_dedup_lookup_refreshes_stream_lru():
     """A producer replaying already-acked seqs (lookups only) is
     active — it must not age out of the stream LRU mid-replay while

@@ -791,6 +791,24 @@ def top(args) -> None:
                                        ()), 0.0)
                 print(f"admission: {names[i_lvl]} (rung {i_lvl}, "
                       f"pressure {pressure:.2f})")
+            peer_rows = sorted(
+                (labels[0][1], value)
+                for (name, labels), value in sample.items()
+                if name == "theia_cluster_peer_up" and labels)
+            if peer_rows:
+                # cluster header: per-peer liveness + replication lag
+                # (the theia_repl_* gauges exist on the leader)
+                def _peer_cell(peer, up):
+                    lag = sample.get(
+                        ("theia_repl_lag_records", (("peer", peer),)))
+                    cell = f"{peer} {'up' if up else 'DOWN'}"
+                    if lag is not None:
+                        cell += f" lag {lag:,.0f}"
+                    return cell
+                n_up = sum(1 for _, up in peer_rows if up)
+                print(f"cluster: {n_up}/{len(peer_rows)} peers up — "
+                      + ", ".join(_peer_cell(p, up)
+                                  for p, up in peer_rows))
             pc = sample.get(("theia_store_parts", ()))
             if pc is not None:
                 # parts-engine header: part count, tier residency,

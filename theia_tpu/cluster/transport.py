@@ -1,0 +1,116 @@
+"""Cluster-internal HTTP transport: one choke point for every byte
+that crosses a node boundary.
+
+All peer traffic — heartbeats, WAL frame shipping, resync streams,
+promote RPCs — goes through `ClusterTransport`, which is where the
+network-level fault sites live:
+
+    net.send           before any bytes leave for a peer
+    net.recv           on the server side, before a peer's request is
+                       processed (fired by the API handler via
+                       `fire_recv`)
+    peer.partition     BOTH directions of one link — checked inside
+                       net.send and net.recv, so arming
+                       `peer.partition#node2:error` severs the node2
+                       link symmetrically: the deterministic
+                       network-partition drill (utils/faults.py
+                       grammar; per-peer targeting via `#<peer>`)
+
+Requests carry `X-Theia-Node` (the sender's id) so the receiving side
+can attribute the hit to a link, and the bearer token when the cluster
+is authenticated (peers authenticate to each other exactly like
+producers do — one token, the deployment's service secret).
+"""
+
+from __future__ import annotations
+
+import json
+import ssl
+import urllib.error
+import urllib.request
+from typing import Dict, Optional
+
+from ..utils.faults import fire as _fire_fault
+from ..utils.logging import get_logger
+
+logger = get_logger("cluster")
+
+#: header carrying the sender's node id on every cluster request
+NODE_HEADER = "X-Theia-Node"
+
+
+class PeerUnreachable(Exception):
+    """Transport-level failure talking to a peer (connect/read error,
+    5xx, or an armed partition fault) — retryable, the peer may heal."""
+
+    def __init__(self, peer: str, detail: str) -> None:
+        super().__init__(f"peer {peer} unreachable: {detail}")
+        self.peer = peer
+
+
+def fire_recv(peer: Optional[str], path: str) -> None:
+    """Server-side fault hook: the API handler calls this with the
+    request's X-Theia-Node before processing a /cluster/* request, so
+    a partition drill drops inbound traffic too (a real partition is
+    symmetric)."""
+    if peer:
+        _fire_fault("net.recv", peer=peer, path=path)
+        _fire_fault("peer.partition", peer=peer, path=path)
+
+
+class ClusterTransport:
+    """Minimal JSON/bytes HTTP client for peer calls."""
+
+    def __init__(self, cmap, token: str = "",
+                 ca_cert: Optional[str] = None,
+                 timeout: float = 10.0) -> None:
+        self.cmap = cmap
+        self.token = token
+        self.timeout = float(timeout)
+        self._ctx = (ssl.create_default_context(cafile=ca_cert)
+                     if ca_cert else None)
+
+    def _headers(self, extra: Optional[Dict[str, str]] = None
+                 ) -> Dict[str, str]:
+        h = {NODE_HEADER: self.cmap.self_id}
+        if self.token:
+            h["Authorization"] = f"Bearer {self.token}"
+        if extra:
+            h.update(extra)
+        return h
+
+    def request(self, peer: str, path: str,
+                data: Optional[bytes] = None,
+                headers: Optional[Dict[str, str]] = None,
+                timeout: Optional[float] = None) -> Dict[str, object]:
+        """One GET (data=None) or POST to `peer`; returns the parsed
+        JSON body. Raises PeerUnreachable on transport failure / 5xx /
+        armed partition; an HTTP 4xx surfaces as-is (a protocol error,
+        not a connectivity one)."""
+        url = self.cmap.addr(peer) + path
+        req = urllib.request.Request(
+            url, data=data, headers=self._headers(headers),
+            method="POST" if data is not None else "GET")
+        try:
+            _fire_fault("net.send", peer=peer, path=path)
+            _fire_fault("peer.partition", peer=peer, path=path)
+            with urllib.request.urlopen(
+                    req, timeout=timeout or self.timeout,
+                    context=self._ctx) as resp:
+                raw = resp.read()
+        except urllib.error.HTTPError as e:
+            body = e.read().decode(errors="replace")
+            if e.code >= 500:
+                raise PeerUnreachable(peer, f"{e.code}: {body[:200]}")
+            raise
+        except Exception as e:
+            # URLError (connect), raw socket timeouts, hangups — and
+            # FaultError from an armed net/partition site: all the
+            # same "link is down" class to the caller
+            raise PeerUnreachable(
+                peer, f"{type(e).__name__}: "
+                      f"{getattr(e, 'reason', None) or e}")
+        try:
+            return json.loads(raw) if raw else {}
+        except json.JSONDecodeError as e:
+            raise PeerUnreachable(peer, f"undecodable response: {e}")

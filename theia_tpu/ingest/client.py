@@ -69,15 +69,30 @@ def parse_retry_after(headers, body: str) -> float:
 
 
 class IngestClient:
-    """One producer stream against a manager's POST /ingest."""
+    """One producer stream against a manager's POST /ingest.
 
-    def __init__(self, addr: str, stream: Optional[str] = None,
+    Cluster-aware: `addr` may be a LIST of manager endpoints (or a
+    comma-separated string) — on connection refusal / 5xx the client
+    fails over to the next endpoint under the same jittered backoff,
+    so a producer rides a leader failover without reconfiguration. A
+    `307 + Location` answer (a follower pointing at the current
+    leader, or a non-owner node pointing at the shard owner) re-targets
+    the client immediately, without burning a backoff sleep."""
+
+    def __init__(self, addr, stream: Optional[str] = None,
                  token: str = "", ca_cert: Optional[str] = None,
                  timeout: float = 30.0, max_attempts: int = 12,
                  backoff_base: float = 0.2, backoff_cap: float = 10.0,
                  rng: Optional[random.Random] = None,
                  sleep: Callable[[float], None] = time.sleep) -> None:
-        self.addr = addr.rstrip("/")
+        if isinstance(addr, str):
+            addrs = [a.strip() for a in addr.split(",") if a.strip()]
+        else:
+            addrs = [str(a).strip() for a in addr]
+        if not addrs:
+            raise ValueError("at least one manager address required")
+        self.addrs = [a.rstrip("/") for a in addrs]
+        self._addr_i = 0
         self.stream = stream or f"p-{uuid.uuid4().hex[:12]}"
         self.token = token
         self.timeout = timeout
@@ -95,6 +110,36 @@ class IngestClient:
         self.duplicates = 0
         self.rejected = 0     # 429 responses absorbed
         self.retries = 0      # 503/connection retries absorbed
+        self.failovers = 0    # endpoint rotations after a failure
+        self.redirects = 0    # 307 Location re-targets honored
+
+    @property
+    def addr(self) -> str:
+        """The endpoint currently in use (failover/redirect move it)."""
+        return self.addrs[self._addr_i]
+
+    def _fail_over(self) -> None:
+        """Rotate to the next configured endpoint (no-op with one)."""
+        if len(self.addrs) > 1:
+            self._addr_i = (self._addr_i + 1) % len(self.addrs)
+            self.failovers += 1
+
+    def _redirect_to(self, location: str) -> bool:
+        """Honor a Location-style redirect: re-target this client at
+        the indicated node's base address (added to the endpoint list
+        if new). Returns False for an unusable Location."""
+        try:
+            parts = urllib.parse.urlsplit(location)
+        except ValueError:
+            return False
+        if not parts.scheme or not parts.netloc:
+            return False
+        base = f"{parts.scheme}://{parts.netloc}"
+        if base not in self.addrs:
+            self.addrs.append(base)
+        self._addr_i = self.addrs.index(base)
+        self.redirects += 1
+        return True
 
     def _headers(self) -> Dict[str, str]:
         h = {"Content-Type": "application/octet-stream"}
@@ -102,21 +147,31 @@ class IngestClient:
             h["Authorization"] = f"Bearer {self.token}"
         return h
 
-    def send(self, payload: bytes,
-             seq: Optional[int] = None) -> Dict[str, object]:
+    def send(self, payload: bytes, seq: Optional[int] = None,
+             stream: Optional[str] = None) -> Dict[str, object]:
         """POST one batch, retrying until acknowledged (or the attempt
         budget runs out). Returns the manager's ack; `duplicate: true`
         means a previous attempt already landed — the ledger counts it
-        once either way."""
-        if seq is None:
-            self.seq += 1
-            seq = self.seq
-        else:
-            self.seq = max(self.seq, int(seq))
-        url = (f"{self.addr}/ingest?"
-               f"stream={urllib.parse.quote(self.stream)}&seq={seq}")
+        once either way. `stream` overrides this client's stream id
+        for one send (the cluster router stamps origin-scoped
+        sub-streams through one shared client per peer)."""
+        if stream is None:
+            stream = self.stream
+            if seq is None:
+                self.seq += 1
+                seq = self.seq
+            else:
+                self.seq = max(self.seq, int(seq))
+        # an explicit stream with seq=None stays UNSTAMPED (the
+        # router forwarding an unstamped producer batch): at-least-
+        # once, the pre-seq contract — the auto-increment belongs to
+        # the client's own stream only
         last: Optional[str] = None
+        redirects_left = len(self.addrs) + 4
         for attempt in range(1, self.max_attempts + 1):
+            url = (f"{self.addr}/ingest?"
+                   f"stream={urllib.parse.quote(stream)}"
+                   + (f"&seq={seq}" if seq is not None else ""))
             try:
                 req = urllib.request.Request(
                     url, method="POST", data=payload,
@@ -133,6 +188,22 @@ class IngestClient:
                 return out
             except urllib.error.HTTPError as e:
                 body = e.read().decode(errors="replace")
+                if e.code in (307, 308):
+                    # "not the node you want": a follower naming the
+                    # leader, a non-owner naming the shard owner —
+                    # re-target and retry immediately (no backoff; the
+                    # named node is presumed healthy)
+                    loc = e.headers.get("Location", "")
+                    redirects_left -= 1
+                    if redirects_left >= 0 and self._redirect_to(loc):
+                        logger.v(1).info(
+                            "ingest stream=%s redirected to %s",
+                            stream, self.addr)
+                        continue
+                    raise IngestError(
+                        f"batch seq={seq} redirect refused "
+                        f"(Location {loc!r}: unusable or a redirect "
+                        f"loop)")
                 if e.code == 429:
                     self.rejected += 1
                     delay = (parse_retry_after(e.headers, body)
@@ -153,6 +224,8 @@ class IngestClient:
                                              self.backoff_cap,
                                              attempt, self._rng)
                     last = f"{e.code}: {body[:200]}"
+                    # a 5xx node may be mid-failover: try a peer next
+                    self._fail_over()
                 else:
                     raise IngestError(
                         f"batch seq={seq} permanently rejected "
@@ -171,6 +244,9 @@ class IngestClient:
                                          self._rng)
                 last = (f"unreachable: "
                         f"{getattr(e, 'reason', None) or e!r}")
+                # connection refused / timed out: rotate endpoints so
+                # a killed leader doesn't eat the whole retry budget
+                self._fail_over()
             if attempt >= self.max_attempts:
                 break   # budget spent — don't sleep just to raise
             logger.v(1).info(
@@ -190,4 +266,6 @@ class IngestClient:
             "duplicates": self.duplicates,
             "rejected429": self.rejected,
             "transientRetries": self.retries,
+            "failovers": self.failovers,
+            "redirects": self.redirects,
         }

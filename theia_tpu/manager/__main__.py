@@ -138,6 +138,26 @@ def main(argv=None) -> None:
                    help="require the bearer token stored here; a fresh "
                         "random token is generated into the file if "
                         "absent (mode 0600)")
+    p.add_argument("--peers", default=None,
+                   help="cluster peer list (env THEIA_CLUSTER_PEERS): "
+                        "'id=http://host:port,...' identical on every "
+                        "node; enables the multi-node tier "
+                        "(docs/cluster.md)")
+    p.add_argument("--node-id", default=None,
+                   help="this node's id in --peers (env "
+                        "THEIA_CLUSTER_SELF; default: the first peer)")
+    p.add_argument("--role", default=None,
+                   choices=["leader", "follower", "peer"],
+                   help="cluster role (env THEIA_CLUSTER_ROLE, default "
+                        "peer): leader ships its WAL to the others "
+                        "(quorum acks via THEIA_REPL_ACKS); follower "
+                        "applies it and redirects ingest; peer joins "
+                        "the ingest-routing mesh")
+    p.add_argument("--repl-acks", default=None,
+                   choices=["leader", "quorum", "all"],
+                   help="replication ack policy (env THEIA_REPL_ACKS, "
+                        "default quorum): how many copies must hold a "
+                        "batch before it is acknowledged")
     p.add_argument("--reconcile-dir", default=None,
                    help="reconcile CR YAML documents in this directory "
                         "into jobs (the CRD control-plane seam; status "
@@ -299,7 +319,14 @@ def main(argv=None) -> None:
         tls_key=args.tls_key, tls_ca=args.tls_ca,
         auth_token=args.auth_token,
         auth_token_file=args.auth_token_file,
-        ingest_shards=args.ingest_shards)
+        ingest_shards=args.ingest_shards,
+        cluster_peers=args.peers, cluster_self=args.node_id,
+        cluster_role=args.role, cluster_acks=args.repl_acks)
+    if server.cluster is not None:
+        print(f"cluster node {server.cluster.cmap.self_id} "
+              f"role={server.cluster.role} "
+              f"peers={','.join(server.cluster.cmap.order)}",
+              file=sys.stderr)
     if server.auth_token:
         print("API authentication enabled (bearer token)",
               file=sys.stderr)

@@ -202,19 +202,37 @@ class DedupWindow:
     batches. `lookup` answers a retry without touching decoder, store,
     or detector state; beyond the window (or for unstamped batches)
     ingest degrades to at-least-once, which is the pre-existing
-    contract. Streams are bounded too (LRU): an adversary minting
-    stream ids cannot grow the table without bound."""
+    contract.
+
+    Cardinality hardening (the ROADMAP item-5 pre-work): every
+    operation is O(1) — streams are an OrderedDict LRU
+    (`THEIA_INGEST_DEDUP_STREAMS`, default 8192), total entries carry
+    a RUNNING count (stats() no longer walks every stream), and a
+    GLOBAL entry budget (`THEIA_INGEST_DEDUP_ENTRIES`, default 2^20)
+    bounds aggregate memory by evicting whole least-recently-active
+    streams — so ~100k distinct stream ids (a router mesh's
+    `stream@origin` sub-streams, a fleet minting producer ids) cost
+    bounded memory and constant-time ops, not 100k × window dicts."""
 
     def __init__(self, window: Optional[int] = None,
-                 max_streams: int = 1024) -> None:
+                 max_streams: Optional[int] = None,
+                 max_entries: Optional[int] = None) -> None:
         self.window = (env_int("THEIA_INGEST_DEDUP_WINDOW", 1024)
                        if window is None else int(window))
-        self.max_streams = int(max_streams)
+        self.max_streams = (env_int("THEIA_INGEST_DEDUP_STREAMS", 8192)
+                            if max_streams is None
+                            else int(max_streams))
+        self.max_entries = (env_int("THEIA_INGEST_DEDUP_ENTRIES",
+                                    1 << 20)
+                            if max_entries is None
+                            else int(max_entries))
         self._streams: "collections.OrderedDict[str, collections.OrderedDict[int, int]]" = (
             collections.OrderedDict())
+        self._entries = 0
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
+        self.evicted_streams = 0
 
     def lookup(self, stream: str, seq: Optional[int]) -> Optional[int]:
         """Rows acked for `(stream, seq)`, or None (unseen/evicted/
@@ -244,21 +262,55 @@ class DedupWindow:
                 win = self._streams[stream] = collections.OrderedDict()
             else:
                 self._streams.move_to_end(stream)
-            win[int(seq)] = int(rows)
-            win.move_to_end(int(seq))
+            seq = int(seq)
+            if seq not in win:
+                self._entries += 1
+            win[seq] = int(rows)
+            win.move_to_end(seq)
             while len(win) > self.window:
                 win.popitem(last=False)
-            while len(self._streams) > self.max_streams:
-                evicted, _ = self._streams.popitem(last=False)
-                logger.v(1).info(
-                    "dedup window evicted idle stream %r", evicted)
+                self._entries -= 1
+            self._evict_over_budget_locked()
+
+    def _evict_over_budget_locked(self) -> None:
+        """Drop whole least-recently-active streams until both the
+        stream LRU and the global entry budget hold — amortized O(1):
+        each stream is inserted once and evicted at most once."""
+        while (len(self._streams) > self.max_streams
+               or (self.max_entries > 0
+                   and self._entries > self.max_entries
+                   and len(self._streams) > 1)):
+            evicted, win = self._streams.popitem(last=False)
+            self._entries -= len(win)
+            self.evicted_streams += 1
+            logger.v(1).info(
+                "dedup window evicted idle stream %r (%d entries)",
+                evicted, len(win))
+
+    def dump(self, limit: int = 1 << 20) -> List[Tuple[str, int, int]]:
+        """(stream, seq, rows) snapshot of every live entry — shipped
+        inside a cluster resync so a freshly-synced follower answers
+        producer retries duplicate:true after a failover. Bounded by
+        `limit` newest-stream-first."""
+        out: List[Tuple[str, int, int]] = []
+        with self._lock:
+            for stream in reversed(self._streams):
+                win = self._streams[stream]
+                for seq, rows in win.items():
+                    out.append((stream, seq, rows))
+                if len(out) >= limit:
+                    break
+        return out[:limit]
 
     def stats(self) -> Dict[str, object]:
         with self._lock:
             return {
                 "window": self.window,
                 "streams": len(self._streams),
-                "entries": sum(len(w) for w in self._streams.values()),
+                "maxStreams": self.max_streams,
+                "entries": self._entries,
+                "maxEntries": self.max_entries,
+                "evictedStreams": self.evicted_streams,
                 "hits": self.hits,
                 "misses": self.misses,
             }

@@ -212,6 +212,7 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
     retention = None  # RetentionLoop
     maintenance = None  # PartMaintenanceLoop (parts engine)
     queries = None    # QueryEngine
+    cluster = None    # ClusterNode (multi-node tier)
     auth_token: Optional[str] = None
     quiet = True
     # Socket timeout (StreamRequestHandler honors it): a client that
@@ -319,6 +320,7 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
     # -- verbs -----------------------------------------------------------
 
     def do_GET(self) -> None:  # noqa: N802
+        from ..cluster import StaleReadError
         from .admission import AdmissionRejected
         try:
             self._get()
@@ -328,6 +330,10 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
             # heavy reads (/query) ride the pressure ladder — over
             # capacity is 429 + Retry-After, distinct from 503
             self._send_retry_after(e)
+        except StaleReadError as e:
+            # bounded-staleness follower read over budget: retryable
+            # here after catch-up, or read from the leader
+            self._send_error_json(503, str(e))
         except AllReplicasDownError as e:
             # "retry later", not "server bug": every store copy is out
             self._send_error_json(503, str(e))
@@ -339,6 +345,11 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
             self._send_error_json(500, f"{type(e).__name__}: {e}")
 
     def do_POST(self) -> None:  # noqa: N802
+        from ..cluster import (
+            ClusterStateError,
+            ReplicationLagError,
+            RouterForwardError,
+        )
         from .admission import AdmissionRejected
         from .ingest import StreamCapacityError
         try:
@@ -346,15 +357,17 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
             self._post()
         except AuthError as e:
             self._send_auth_error(e)
-        except DuplicateJobError as e:
+        except (DuplicateJobError, ClusterStateError) as e:
             self._send_error_json(409, str(e))
         except AdmissionRejected as e:
             # over CAPACITY (retry later, we are fine) — deliberately
             # distinct from 503 (the store itself is unavailable)
             self._send_retry_after(e)
-        except (StreamCapacityError, AllReplicasDownError) as e:
+        except (StreamCapacityError, AllReplicasDownError,
+                ReplicationLagError, RouterForwardError) as e:
             # retryable capacity/availability condition, not a client
-            # payload error
+            # payload error: quorum not met, owner unreachable, every
+            # replica down — the producer's retry is dedup-idempotent
             self._send_error_json(503, str(e))
         except KeyError:
             self._send_error_json(404, f"not found: {self.path}")
@@ -413,6 +426,16 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
             # shed_detector rung, 429 + Retry-After).
             self._require_auth()
             self._serve_query(self._plan_from_get())
+            return
+        if parts == ("cluster", "ping"):
+            # peer liveness + log-matching handshake; open (the
+            # /healthz liveness class — no decoded identities). The
+            # recv-side fault hook makes partition drills symmetric.
+            from ..cluster.transport import NODE_HEADER, fire_recv
+            fire_recv(self.headers.get(NODE_HEADER), "/cluster/ping")
+            if self.cluster is None:
+                raise KeyError(self.path)
+            self._send_json(self.cluster.ping_doc())
             return
         if parts == ("healthz",):
             self._send_json(self._health_doc())
@@ -591,6 +614,15 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
                 ws = None
             if ws:
                 doc["wal"] = ws
+        # Cluster tier: role/term, peer liveness, replication lag or
+        # follower staleness, router counters. A down peer or a
+        # non-streaming follower degrades the node (it still serves).
+        cluster = getattr(self, "cluster", None)
+        if cluster is not None:
+            cdoc = cluster.health_doc()
+            if cdoc.pop("degraded", False) and doc["status"] == "ok":
+                doc["status"] = "degraded"
+            doc["cluster"] = cdoc
         armed = _faults.armed_sites()
         if armed:
             doc["faults"] = {"armed": armed}
@@ -738,11 +770,37 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
         through the verb handlers' taxonomy."""
         if self.queries is None:
             raise KeyError(self.path)
+        if self.cluster is not None:
+            # bounded-staleness follower reads: a copy that lost its
+            # leader answers 503, not silently stale data
+            self.cluster.check_query_staleness()
         adm = getattr(self.ingest, "admission", None) \
             if self.ingest is not None else None
         if adm is not None:
             adm.admit_query()
         self._send_json(self.queries.execute(plan))
+
+    def _send_ingest_redirect(self) -> None:
+        """307 + Location at the current leader: this node is a
+        follower and must not take writes (the Distributed-table
+        'wrong shard' answer). Body carries the leader for clients
+        that read JSON instead of headers."""
+        target = self.cluster.leader_addr()
+        if not target:
+            raise AllReplicasDownError(
+                "this node is a follower and no leader is known yet")
+        location = target + self.path
+        raw = json.dumps({
+            "kind": "Status", "status": "Failure", "code": 307,
+            "message": f"node {self.cluster.cmap.self_id} is a "
+                       f"follower; ingest at the leader",
+            "location": location}).encode()
+        self.send_response(307)
+        self.send_header("Location", location)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(raw)))
+        self.end_headers()
+        self.wfile.write(raw)
 
     def _post(self) -> None:
         parts = self._route()
@@ -751,6 +809,13 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
             self._serve_query(parse_plan(self._read_body()))
             return
         if parts == ("ingest",):
+            if self.cluster is not None and \
+                    not self.cluster.accepts_ingest():
+                # drain the body first: answering 307 mid-upload makes
+                # some clients choke on the connection reset
+                self._read_raw_body()
+                self._send_ingest_redirect()
+                return
             q = self._query()
             stream = q.get("stream", "default")
             seq_raw = q.get("seq")
@@ -763,6 +828,9 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
                 raise ValueError("empty ingest payload")
             self._send_json(self.ingest.ingest(payload, stream=stream,
                                                seq=seq))
+            return
+        if parts and parts[0] == "cluster":
+            self._post_cluster(parts)
             return
         if self.path.startswith(GROUP_INTELLIGENCE) and len(parts) == 4:
             kind = _RESOURCE_KIND[parts[3]]
@@ -783,6 +851,32 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
             body = self._read_body()
             self._send_json(self.profiles.create(
                 float(body.get("durationSeconds", 3.0) or 3.0)), 201)
+            return
+        raise KeyError(self.path)
+
+    def _post_cluster(self, parts) -> None:
+        """Cluster control/replication plane (token-gated with every
+        other POST): /cluster/replicate takes a batch of raw WAL
+        frames, /cluster/resync a wholesale catch-up stream,
+        /cluster/promote the WAL-delimited failover cutover."""
+        from ..cluster.transport import NODE_HEADER, fire_recv
+        if self.cluster is None:
+            raise KeyError(self.path)
+        fire_recv(self.headers.get(NODE_HEADER),
+                  "/" + "/".join(parts))
+        if parts == ("cluster", "replicate"):
+            self._send_json(self.cluster.handle_replicate(
+                self._read_raw_body(), self.headers))
+            return
+        if parts == ("cluster", "resync"):
+            self._send_json(self.cluster.handle_resync(
+                self._read_raw_body(), self.headers))
+            return
+        if parts == ("cluster", "promote"):
+            body = self._read_body()
+            at = body.get("atLsn")
+            self._send_json(self.cluster.promote(
+                int(at) if at is not None else None))
             return
         raise KeyError(self.path)
 
@@ -859,7 +953,13 @@ class TheiaManagerServer:
                  tls_ca: Optional[str] = None,
                  auth_token: Optional[str] = None,
                  auth_token_file: Optional[str] = None,
-                 ingest_shards: Optional[int] = None) -> None:
+                 ingest_shards: Optional[int] = None,
+                 cluster_peers: Optional[str] = None,
+                 cluster_self: Optional[str] = None,
+                 cluster_role: Optional[str] = None,
+                 cluster_acks: Optional[str] = None) -> None:
+        import os as _os
+
         from .ingest import IngestManager
         self.ingest = IngestManager(db, n_shards=ingest_shards)
         self.controller = JobController(
@@ -920,6 +1020,32 @@ class TheiaManagerServer:
                 self.maintenance = PartMaintenanceLoop(
                     db, interval=merge_interval)
 
+        # Multi-node cluster tier (theia_tpu/cluster): membership +
+        # heartbeats, and per role the replication leader (WAL
+        # shipping + quorum acks wired into the ingest durability
+        # gate), the follower applier, or the ingest router. Off
+        # entirely without a peer list — single-node managers carry
+        # zero cluster overhead.
+        self.cluster = None
+        peers_spec = (cluster_peers
+                      if cluster_peers is not None
+                      else _os.environ.get("THEIA_CLUSTER_PEERS", ""))
+        if peers_spec.strip():
+            from ..cluster import ClusterNode
+            self.cluster = ClusterNode(
+                db, self.ingest, peers=peers_spec,
+                self_id=cluster_self, role=cluster_role,
+                acks=cluster_acks, token=self.auth_token or "")
+            # wired unconditionally: the gate checks the node's role
+            # at CALL time, so a follower promoted to leader later
+            # starts enforcing the quorum without rewiring
+            self.ingest.durability_gate = self.cluster.durability_gate
+            if self.ingest.admission is not None:
+                from ..utils.env import env_int as _env_int
+                self.ingest.admission.add_signal(
+                    "replLag", self.cluster.repl_lag,
+                    _env_int("THEIA_REPL_LAG_HIGH", 10_000))
+
         handler = type("BoundHandler", (ManagerAPIHandler,), {
             "controller": self.controller,
             "stats": self.stats,
@@ -929,6 +1055,7 @@ class TheiaManagerServer:
             "retention": self.retention,
             "maintenance": self.maintenance,
             "queries": self.queries,
+            "cluster": self.cluster,
             "auth_token": self.auth_token,
         })
         self.httpd = _TLSCapableServer((address, port), handler)
@@ -961,6 +1088,9 @@ class TheiaManagerServer:
             self.retention.start()
         if self.maintenance is not None:
             self.maintenance.start()
+        if self.cluster is not None:
+            # after the socket bind: peers probe us back immediately
+            self.cluster.start()
         self._thread: Optional[threading.Thread] = None
         self._serving = False
 
@@ -987,6 +1117,8 @@ class TheiaManagerServer:
             self.retention.stop()
         if self.maintenance is not None:
             self.maintenance.stop()
+        if self.cluster is not None:
+            self.cluster.stop()
         self.ingest.close()
         self.controller.shutdown()
         if self._thread:

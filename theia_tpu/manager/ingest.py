@@ -69,13 +69,14 @@ import threading
 import time
 import zlib
 from concurrent.futures import ThreadPoolExecutor
-from typing import Deque, Dict, List, Optional, Tuple
+from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..analytics.heavy_hitters import HeavyHitterDetector
 from ..analytics.streaming import StreamingDetector
 from ..ingest.native import BLOCK_MAGIC, BLOCK_MAGIC_V1, TsvDecoder
+from ..store.wal import RECORD_MAGIC
 from ..obs import metrics as _metrics
 from ..obs import trace as _trace
 from ..schema import ColumnarBatch, DictionaryMapper, StringDictionary
@@ -344,6 +345,15 @@ class IngestManager:
                     "fusedQueue", self._fused.queue_depth,
                     env_int("THEIA_FUSED_QUEUE_HIGH", 0)
                     or self._fused.queue_capacity)
+        # -- cluster tier hooks (theia_tpu/cluster wires these) ------
+        # Router: split decoded batches by owner node, forward remote
+        # slices (role `peer` routing mesh).
+        self.router = None
+        # Durability gate: called after the local insert leg, before
+        # the acknowledgement — the replication leader blocks here
+        # until the configured follower ack quorum holds the batch
+        # (raises ReplicationLagError → HTTP 503).
+        self.durability_gate: Optional[Callable[[], None]] = None
         # Exactly-once retried ingest: (stream, seq)-stamped batches
         # dedup against this window; recovery re-seeds it from the
         # tags the WAL replay surfaced, so the idempotency contract
@@ -357,6 +367,18 @@ class IngestManager:
         # original acks.
         self._pending_lock = threading.Lock()
         self._pending: set = set()
+        # Decoded-but-unacknowledged batches parked by a post-decode
+        # failure (replication-quorum timeout, forwarded-slice
+        # failure, insert error): the DECODE already advanced the
+        # stream's dictionary-delta chain, so the producer's mandated
+        # same-bytes retry must NOT decode again (the delta base no
+        # longer matches — "dictionary desync") — it replays the
+        # parked decoded batch instead. One entry per stream (a
+        # producer retries its failed block before sending the next),
+        # bounded, cleared on success.
+        self._parked_lock = threading.Lock()
+        self._parked: "collections.OrderedDict[str, Tuple[int, ColumnarBatch]]" = (
+            collections.OrderedDict())
         recovered = getattr(db, "recovered_acks", None)
         if callable(recovered):
             n_seeded = 0
@@ -542,40 +564,133 @@ class IngestManager:
             # raises AdmissionRejected → 429 + Retry-After (payload
             # bytes are charged here; rows after decode)
             level = self.admission.admit(stream, len(payload))
-        st = self._stream(stream)
-        # The stream lock guards only the DECODE (the dictionary-delta
-        # chain is per-stream state); the store insert runs outside it,
-        # so one producer's slow insert (TTL scan, MV fan-out) never
-        # blocks its next block's decode on another thread, and
-        # different streams insert fully concurrently. Store-visible
-        # order across racing blocks of one stream is not defined — the
-        # store orders by timeInserted, not arrival, exactly like
-        # concurrent INSERTs on one ClickHouse connection pool. The
-        # same holds for the DETECTOR leg: streaming state (CMS counts,
-        # EWMA recurrences) is order-sensitive, so a producer that
-        # pipelines blocks of one stream concurrently gets
-        # nondeterministic alert output for the racing blocks; a
-        # producer that needs reproducible alerting must await each
-        # response before sending the next block.
-        with st.lock:
+        is_record = payload[:4] == RECORD_MAGIC
+        parked = None
+        if seq is not None and not is_record:
+            with self._parked_lock:
+                pk = self._parked.get(stream)
+                if pk is not None and pk[0] == seq:
+                    parked = pk[1]
+        if parked is not None:
+            # this block already decoded once (its failed attempt
+            # advanced the stream's delta chain and charged the row
+            # bucket) — replay the decoded form, don't decode again
+            batch = parked
+        elif is_record:
+            # Self-contained WAL-record payload (a router forward or a
+            # demoted leader's tail re-ingest): decodes statelessly —
+            # no stream slot, no dictionary-delta chain, and NEVER
+            # re-routed (its origin already placed it).
             t_dec = time.perf_counter()
             try:
-                if payload[:4] in (BLOCK_MAGIC, BLOCK_MAGIC_V1):
-                    batch = st.decoder.decode_block(payload)
-                else:
-                    batch = st.decoder.decode(payload)
-            except Exception:
-                # A failed decode may have partially advanced the
-                # dictionaries (TSV minting is not transactional) —
-                # discard the stream rather than serve a desynced one.
-                self._drop_stream(stream, st)
+                from ..store.wal import (decode_record_body,
+                                         split_dedup_tag)
+                table, batch = decode_record_body(payload[4:])
+                # a tail re-ingest ships the original (tagged) record
+                # verbatim; identity comes from the query params, the
+                # embedded tag is informational
+                table, _tag = split_dedup_tag(table)
+                if table != "flows":
+                    raise ValueError(
+                        f"TREC payload targets table {table!r}")
+            except ValueError:
                 _M_ERRORS.labels(stage="decode").inc()
                 raise
+            except Exception as e:
+                _M_ERRORS.labels(stage="decode").inc()
+                raise ValueError(f"undecodable TREC payload: {e}")
             _M_STAGE_DECODE.observe(time.perf_counter() - t_dec)
-        if self.admission is not None:
+        else:
+            st = self._stream(stream)
+            # The stream lock guards only the DECODE (the dictionary-
+            # delta chain is per-stream state); the store insert runs
+            # outside it, so one producer's slow insert (TTL scan, MV
+            # fan-out) never blocks its next block's decode on another
+            # thread, and different streams insert fully concurrently.
+            # Store-visible order across racing blocks of one stream
+            # is not defined — the store orders by timeInserted, not
+            # arrival, exactly like concurrent INSERTs on one
+            # ClickHouse connection pool. The same holds for the
+            # DETECTOR leg: streaming state (CMS counts, EWMA
+            # recurrences) is order-sensitive, so a producer that
+            # pipelines blocks of one stream concurrently gets
+            # nondeterministic alert output for the racing blocks; a
+            # producer that needs reproducible alerting must await
+            # each response before sending the next block.
+            with st.lock:
+                t_dec = time.perf_counter()
+                try:
+                    if payload[:4] in (BLOCK_MAGIC, BLOCK_MAGIC_V1):
+                        batch = st.decoder.decode_block(payload)
+                    else:
+                        batch = st.decoder.decode(payload)
+                except Exception:
+                    # A failed decode may have partially advanced the
+                    # dictionaries (TSV minting is not transactional)
+                    # — discard the stream rather than serve a
+                    # desynced one.
+                    self._drop_stream(stream, st)
+                    _M_ERRORS.labels(stage="decode").inc()
+                    raise
+                _M_STAGE_DECODE.observe(time.perf_counter() - t_dec)
+        if parked is None and self.admission is not None:
             # post-decode row accounting: the row bucket may go into
             # debt, which rejects FUTURE requests until it refills
             self.admission.charge_rows(stream, len(batch))
+        try:
+            out = self._apply_decoded(batch, stream, seq, level,
+                                      t_req, is_record)
+        except Exception:
+            if seq is not None and not is_record:
+                # the stream's delta chain is already advanced past
+                # this block: hold its decoded form for the retry
+                self._park(stream, seq, batch)
+            raise
+        if seq is not None and not is_record:
+            self._unpark(stream, seq)
+        return out
+
+    #: parked decoded batches are capped (failure-path state only;
+    #: entries clear the moment a retry succeeds)
+    MAX_PARKED = 4 * MAX_STREAMS
+
+    def _park(self, stream: str, seq: int, batch: ColumnarBatch) -> None:
+        with self._parked_lock:
+            self._parked[stream] = (int(seq), batch)
+            self._parked.move_to_end(stream)
+            while len(self._parked) > self.MAX_PARKED:
+                self._parked.popitem(last=False)
+
+    def _unpark(self, stream: str, seq: int) -> None:
+        with self._parked_lock:
+            pk = self._parked.get(stream)
+            if pk is not None and pk[0] == int(seq):
+                del self._parked[stream]
+
+    def _apply_decoded(self, batch: ColumnarBatch, stream: str,
+                       seq: Optional[int], level: int, t_req: float,
+                       is_record: bool) -> Dict[str, object]:
+        """Everything after a successful decode: routing, the
+        pipelined insert ∥ score legs, the replication durability
+        gate, dedup acks, and the response. Split out so a failure
+        anywhere in here can park the decoded batch for the retry."""
+        # -- cluster routing: keep owned rows, forward the rest --------
+        # (before the pipelined legs: forwards overlap the local
+        # insert/score work; owners admit/score/dedup their slices
+        # themselves). A retry re-splits identically — the hash is a
+        # pure function of the rows — so owners answer duplicate:true
+        # and the local slice dedups under its origin sub-stream.
+        routed = None
+        eff_stream = stream
+        local_dup: Optional[int] = None
+        if self.router is not None and not is_record:
+            local_batch, remote = self.router.split(batch)
+            if remote:
+                routed = self.router.forward_all(remote, stream, seq)
+                batch = local_batch
+                if seq is not None:
+                    eff_stream = self.router.sub_stream(stream)
+                    local_dup = self.dedup.lookup(eff_stream, seq)
         # Pipelined legs: the store insert (MV fan-out, TTL) and the
         # detector scoring are independent consumers of the decoded
         # batch (both read-only), so they run overlapped and the
@@ -592,17 +707,26 @@ class IngestManager:
         # exactly-once.
         # the tag carries the LOGICAL batch size so a sharded store's
         # per-slice WAL records can reconstruct (and sanity-check) the
-        # whole ack at recovery
-        dedup_tag = ((stream, seq, len(batch))
+        # whole ack at recovery; a routed batch tags its LOCAL slice
+        # under the origin sub-stream (the owners tag their own)
+        dedup_tag = ((eff_stream, seq, len(batch))
                      if seq is not None else None)
-        fut = self._submit_insert(self._timed_insert, batch, dedup_tag)
+        skip_local = local_dup is not None or len(batch) == 0
+        fut = None
+        if not skip_local:
+            fut = self._submit_insert(self._timed_insert, batch,
+                                      dedup_tag)
         # Brownout: under pressure the scoring leg degrades first —
         # sampled at a declining fraction, then fully shed — while the
         # durable leg (WAL + store) keeps acknowledging rows.
         scored = (level == LEVEL_OK
                   or (self.admission is not None
                       and self.admission.should_score(level)))
-        if scored:
+        if skip_local:
+            # local slice already landed (a routed retry) or every row
+            # belongs to a remote owner — nothing to insert or score
+            alerts, conn_alerts, n_conn = [], [], 0
+        elif scored:
             try:
                 t_det = time.perf_counter()
                 alerts, conn_alerts, n_conn = self.score_batch(batch)
@@ -619,21 +743,43 @@ class IngestManager:
                 # desyncing its delta chain), exactly as a
                 # crash+replay of the same record would behave.
                 if fut.exception() is None and seq is not None:
-                    self.dedup.record(stream, seq, fut.result())
+                    self.dedup.record(eff_stream, seq, fut.result())
                 raise
         else:
             alerts, conn_alerts, n_conn = [], [], 0
             _M_SHED_ROWS.labels(mode=LEVEL_NAMES[level]).inc(
                 len(batch))
-        insert_exc = fut.exception()
-        if insert_exc is not None:
-            _M_ERRORS.labels(stage="store_insert").inc()
-            raise insert_exc
-        n = fut.result()
+        if fut is not None:
+            insert_exc = fut.exception()
+            if insert_exc is not None:
+                _M_ERRORS.labels(stage="store_insert").inc()
+                raise insert_exc
+            n = fut.result()
+        else:
+            n = local_dup or 0
+        if seq is not None and routed is not None and fut is not None:
+            # the local slice is durable: a retry of this batch must
+            # not re-insert it even though the whole-batch ack below
+            # is still pending on the forwards
+            self.dedup.record(eff_stream, seq, n)
+        remote_rows = 0
+        if routed is not None:
+            # owners ack (or answer duplicate:true for) their slices;
+            # a slice that exhausts its retry budget raises
+            # RouterForwardError → HTTP 503 → the producer retries the
+            # whole batch idempotently
+            remote_rows, _dups = self.router.await_all(routed)
+        if self.durability_gate is not None and not skip_local:
+            # replication quorum: block the acknowledgement until the
+            # configured follower quorum holds the local WAL append
+            # (raises ReplicationLagError → HTTP 503, retry-safe)
+            self.durability_gate()
+        total = n + remote_rows
         if seq is not None:
-            # the ack is now durable to the WAL's policy bound; a
-            # retry of this (stream, seq) is idempotent from here on
-            self.dedup.record(stream, seq, n)
+            # the ack is now durable to the WAL's policy bound (and
+            # the quorum's, when configured); a retry of this
+            # (stream, seq) is idempotent from here on
+            self.dedup.record(stream, seq, total)
         now = time.time()
         n_alerts = len(alerts) + n_conn
         with self._alerts_lock:
@@ -655,10 +801,14 @@ class IngestManager:
         # would wash real incidents out of the bounded span ring.
         if dt_req >= self.TRACE_SLOW_SECONDS:
             _trace.record("ingest.request", now - dt_req, dt_req,
-                          stream=stream, rows=n, alerts=n_alerts)
+                          stream=stream, rows=total, alerts=n_alerts)
         if n_alerts:
             logger.v(1).info("ingested %d rows, %d alerts", n, n_alerts)
-        out: Dict[str, object] = {"rows": n, "alerts": n_alerts}
+        out: Dict[str, object] = {"rows": total, "alerts": n_alerts}
+        if remote_rows:
+            # rows this node forwarded to their owner-shard peers
+            # (scored and alert-ringed THERE, not here)
+            out["forwardedRows"] = remote_rows
         if not scored:
             # the producer sees its rows were stored but not scored —
             # alert absence under brownout is degradation, not quiet

@@ -244,6 +244,32 @@ def write_part_file(path: str, table: str,
     return _PART_HEADER.size + body_len
 
 
+def read_part_body(path: str) -> bytes:
+    """The verified raw record BODY of a part file — already the exact
+    self-contained WAL record encoding (write_part_file's contract), so
+    cluster resync ships sealed cold parts without decoding a row."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError as e:
+        raise PartsError(f"part {path} unreadable: {e}")
+    if len(data) < _PART_HEADER.size:
+        raise PartsError(f"part {path}: short header")
+    magic, ver, algo, _, crc, body_len = _PART_HEADER.unpack_from(
+        data, 0)
+    if magic != _PART_MAGIC or ver != _PART_VERSION:
+        raise PartsError(f"part {path}: bad magic/version")
+    body = data[_PART_HEADER.size:]
+    if len(body) != body_len:
+        raise PartsError(
+            f"part {path}: body is {len(body)} bytes, header says "
+            f"{body_len}")
+    crc_fn = _wal._checksum_fn(algo)
+    if crc_fn is not None and (crc_fn(body, 0) & 0xFFFFFFFF) != crc:
+        raise PartsError(f"part {path}: checksum mismatch")
+    return body
+
+
 def read_part_file(path: str,
                    columns: Optional[Sequence[str]] = None
                    ) -> ColumnarBatch:
@@ -602,6 +628,35 @@ class PartTable(Table):
     def _snapshot_refs(self) -> Tuple[List[Part], List[ColumnarBatch]]:
         with self._lock:
             return list(self._parts), list(self._batches)
+
+    def export_encoded_records(self, parts: Optional[List[Part]] = None,
+                               mem: Optional[List[ColumnarBatch]] = None,
+                               chunk_rows: int = 65536):
+        """Yield self-contained WAL-record BODIES covering every row of
+        this table in insertion order — the cluster resync shipping
+        format ("ship sealed parts, then the WAL tail"). COLD/lazy
+        parts ship their file body verbatim (it IS the exact record
+        body — zero decode); hot parts and the memtable encode their
+        batches. Pass refs captured under the caller's consistency
+        latch; parts are immutable, so the refs stay valid after the
+        latch releases (a raced maintenance GC unlinking a retired
+        file falls back to the in-RAM decode path)."""
+        if parts is None or mem is None:
+            parts, mem = self._snapshot_refs()
+        for p in parts:
+            if p.chunks is None and p.path is not None:
+                try:
+                    yield read_part_body(p.path)
+                    continue
+                except PartsError:
+                    pass   # fall through: _decode_part re-raises if
+                           # the file is truly gone AND chunks is None
+            yield _wal.encode_record_body(self.name,
+                                          self._decode_part(p))
+        for b in mem:
+            for i in range(0, len(b), chunk_rows):
+                idx = np.arange(i, min(i + chunk_rows, len(b)))
+                yield _wal.encode_record_body(self.name, b.take(idx))
 
     def scan(self) -> ColumnarBatch:
         """Whole-table view, insertion order. Unlike the flat engine

@@ -110,6 +110,12 @@ except ImportError:                     # container default: zlib CRC32
 CRC_ALGO_CRC32C = 1
 CRC_ALGO_ZLIB = 2
 
+#: wire magic for ONE self-contained record body shipped as an ingest
+#: payload (cluster router forwards, demoted-leader tail re-ingest):
+#: `RECORD_MAGIC + encode_record_body(...)` — decodes statelessly, so
+#: it never touches a stream's dictionary-delta chain
+RECORD_MAGIC = b"TREC"
+
 _SEG_MAGIC = b"TWAL"
 _SEG_VERSION = 1
 _SEG_HEADER = struct.Struct("<4sBBHQ")      # magic, ver, algo, 0, first lsn
@@ -194,6 +200,13 @@ def split_dedup_tag(name: str
 
 class WalCorruption(WalError):
     """A segment failed structural or checksum validation."""
+
+
+class WalShipGap(WalError):
+    """A log-shipping read asked for records this log no longer holds
+    (checkpoint GC removed the covering segments) — the follower is too
+    far behind to catch up frame-by-frame and must resync wholesale
+    (part-manifest catch-up), then resume from the resync position."""
 
 
 def _checksum_fn(algo: int) -> Optional[Callable[[bytes, int], int]]:
@@ -335,6 +348,13 @@ def encode_record_parts(table: str, batch: ColumnarBatch
                          + struct.pack("<qI", base, stored.nbytes))
             parts.append(_byteview(stored))
     return parts
+
+
+def encode_record_body(table: str, batch: ColumnarBatch) -> bytes:
+    """One contiguous self-contained record body (the shippable unit:
+    resync records, router-forwarded batches). The framed append path
+    keeps using `encode_record_parts` to avoid the concatenation."""
+    return b"".join(bytes(p) for p in encode_record_parts(table, batch))
 
 
 def decode_record_body(body: bytes,
@@ -518,6 +538,12 @@ class WriteAheadLog:
         self._next_lsn = 1
         self.last_lsn = 0
         self.synced_lsn = 0
+        #: body checksum of the record at `last_lsn` — the log-matching
+        #: handshake token for cluster replication (a follower whose
+        #: (last_lsn, last_body_crc) matches the leader's frame resumes
+        #: frame shipping; a mismatch means divergent histories →
+        #: wholesale resync). None = unknown (forces resync).
+        self.last_body_crc: Optional[int] = 0
         self._dirty_records = 0
         self._dirty_bytes = 0
         self._last_sync_t = clock()
@@ -688,6 +714,7 @@ class WriteAheadLog:
             self._seg_records += 1
             self._next_lsn = lsn + 1
             self.last_lsn = lsn
+            self.last_body_crc = body_crc
             self._dirty_records += 1
             self._dirty_bytes += frame_len
         _M_APPENDED.inc(frame_len)
@@ -757,6 +784,10 @@ class WriteAheadLog:
             self._next_lsn = last_lsn + 1
             self.last_lsn = last_lsn
             self.synced_lsn = last_lsn
+            # the record AT last_lsn lives in a peer's log, not this
+            # one — unknown until something lands here (a cluster
+            # follower's resync sets it from the leader's token)
+            self.last_body_crc = None
             self._dirty_records = 0
             self._dirty_bytes = 0
             self._open_segment_locked(self._next_lsn)
@@ -778,11 +809,14 @@ class WriteAheadLog:
             "lastLsn": 0, "aboveLsn": int(above_lsn),
         }
         segs = self._list_segments()
-        state = {"prev": None, "first": None}
+        state = {"prev": None, "first": None, "crc": None}
         for si, (first, path) in enumerate(segs):
             last_seg = si == len(segs) - 1
             self._replay_segment(path, last_seg, above_lsn, stats,
                                  state, apply)
+        if state["crc"] is not None:
+            # handshake token: the physical last frame's body checksum
+            self.last_body_crc = int(state["crc"])
         if (state["first"] is not None and above_lsn
                 and state["first"] > above_lsn + 1):
             # records between the snapshot stamp and the oldest
@@ -868,6 +902,7 @@ class WriteAheadLog:
                 stats["gapped"] = True
             prev_lsn = lsn
             stats["lastLsn"] = max(int(stats["lastLsn"]), lsn)
+            state["crc"] = body_crc
             if lsn <= above_lsn:
                 # already covered by the snapshot: the frame is
                 # CRC-verified above but NOT decoded — recovery over
@@ -916,6 +951,184 @@ class WriteAheadLog:
                 "WAL %s: dropping remainder of segment at byte %d "
                 "(%d bytes): %s — recovery continues with the next "
                 "segment", path, off, dropped, why)
+
+    # -- log shipping (leader read side / follower write side) -------------
+
+    def read_frames(self, above_lsn: int,
+                    max_bytes: int = 1 << 20
+                    ) -> Tuple[bytes, int, int]:
+        """Raw frames with LSN > `above_lsn`, up to ~`max_bytes` (at
+        least one frame when any exists) — the replication shipper's
+        read side. Returns (frames, last_lsn_shipped, checksum_algo);
+        empty frames means the follower is caught up. Raises
+        WalShipGap when the oldest surviving record is already past
+        `above_lsn + 1` (GC collected the covering segments): the
+        follower must resync wholesale instead. Reading races appends
+        safely — the walk stops at the first incomplete frame (the
+        appender's userspace buffer may spill mid-record)."""
+        with self._io:
+            segs = self._list_segments()
+        if not segs:
+            return b"", int(above_lsn), _WRITE_ALGO
+        # start at the last segment that can contain above_lsn + 1
+        start = 0
+        for i, (first, _) in enumerate(segs):
+            if first <= above_lsn + 1:
+                start = i
+        if segs[start][0] > above_lsn + 1:
+            raise WalShipGap(
+                f"oldest surviving WAL record is LSN {segs[start][0]} "
+                f"but the follower needs {above_lsn + 1} — covering "
+                f"segments were checkpoint-GCed; resync required")
+        out: List[bytes] = []
+        size = 0
+        last = int(above_lsn)
+        ship_algo: Optional[int] = None
+        for first, path in segs[start:]:
+            try:
+                with open(path, "rb") as f:
+                    data = f.read()
+            except OSError:
+                break
+            if len(data) < _SEG_HEADER.size:
+                break
+            magic, ver, algo, _, _f = _SEG_HEADER.unpack_from(data, 0)
+            if magic != _SEG_MAGIC or ver != _SEG_VERSION:
+                break
+            if ship_algo is None:
+                ship_algo = algo
+            elif algo != ship_algo and out:
+                # one ship batch carries ONE checksum algo; a mixed-
+                # algo log (crc32c module came/went across restarts)
+                # ships the remainder on the next call
+                break
+            for lsn, frame, _body in iter_frames(
+                    data[_SEG_HEADER.size:], algo):
+                if lsn <= above_lsn:
+                    continue
+                if lsn != last + 1 and last != above_lsn:
+                    # a gap INSIDE the shipped range (reposition after
+                    # resync): stop here; the follower acks what it
+                    # got and the next read re-evaluates
+                    return (b"".join(out), last,
+                            ship_algo if ship_algo is not None
+                            else _WRITE_ALGO)
+                out.append(frame)
+                size += len(frame)
+                last = lsn
+                if size >= max_bytes:
+                    return b"".join(out), last, ship_algo
+        return (b"".join(out), last,
+                ship_algo if ship_algo is not None else _WRITE_ALGO)
+
+    def shipped_apply(self, lsn: int, frame: bytes, body: bytes,
+                      sender_algo: int,
+                      apply: Callable[[], None]) -> bool:
+        """Log-shipping twin of `logged_apply`: append one PRE-FRAMED
+        record verbatim — preserving its leader-assigned LSN, so the
+        follower's log stays a byte-identical continuation of the
+        leader's and standard replay recovers the follower to an exact
+        leader position — then run the memory apply, atomically with
+        respect to quiesce(). A frame at or below `last_lsn` is a
+        duplicate ship after a reconnect: skipped, returns False. A
+        frame that would leave a gap raises WalError (the shipper must
+        not skip records). The caller runs the sync policy once per
+        shipped batch via `policy_sync()`."""
+        # the HANDSHAKE token must be the sender-algo checksum (the
+        # leader compares against its own frame), even when the frame
+        # is re-framed under our algo for the on-disk copy below
+        sender_crc = _FRAME.unpack_from(frame, 0)[1]
+        if sender_algo != _WRITE_ALGO:
+            # our segment header stamps OUR algo — re-frame so the
+            # checksums on disk match it
+            frame = build_frame(bytes(body), lsn)
+        with self._latch.read():
+            with self._io:
+                if self._closed:
+                    raise WalError("WAL is closed")
+                if self._broken is not None:
+                    raise WalError(
+                        f"WAL broken by earlier write failure: "
+                        f"{self._broken}")
+                if self._file is None:
+                    raise WalError("WAL not open (call open() first)")
+                if lsn <= self.last_lsn:
+                    return False
+                if lsn != self._next_lsn:
+                    raise WalError(
+                        f"shipped frame LSN {lsn} would leave a gap "
+                        f"(next expected {self._next_lsn})")
+                if (self._seg_records and
+                        self._seg_size + len(frame)
+                        > self.segment_bytes):
+                    self._rotate_locked()
+                pre = self._seg_size
+                try:
+                    self._file.write(frame)
+                    self._file.flush()
+                except Exception as e:
+                    try:
+                        self._file.truncate(pre)
+                        self._file.seek(pre)
+                    except OSError:
+                        self._broken = f"{type(e).__name__}: {e}"
+                    raise
+                self._seg_size += len(frame)
+                self._seg_records += 1
+                self._next_lsn = lsn + 1
+                self.last_lsn = lsn
+                self.last_body_crc = sender_crc
+                self._dirty_records += 1
+                self._dirty_bytes += len(frame)
+            apply()
+        _M_APPENDED.inc(len(frame))
+        return True
+
+    def policy_sync(self) -> None:
+        """Run the sync policy once (the shipped-batch ack point)."""
+        self._policy_sync()
+
+    def body_crc_at(self, lsn: int) -> Optional[int]:
+        """Body checksum of the record at `lsn`, or None when this log
+        no longer holds it (GC) — the leader's side of the log-matching
+        handshake."""
+        if lsn <= 0:
+            return 0
+        try:
+            frames, last, _algo = self.read_frames(lsn - 1,
+                                                   max_bytes=1)
+        except WalShipGap:
+            return None
+        if not frames:
+            return None
+        blen, body_crc, got, _hcrc = _FRAME.unpack_from(frames, 0)
+        return body_crc if got == lsn else None
+
+    def reset_to(self, last_lsn: int,
+                 last_body_crc: Optional[int] = None) -> None:
+        """Discard every record and restart the sequence at
+        `last_lsn + 1` — the follower's wholesale-resync landing: its
+        surviving records no longer describe its memory (which was
+        just replaced by the leader's copy), so they are removed, and
+        the handshake token is set from the leader's. The caller has
+        already extracted any divergent tail it intends to re-ingest.
+        NOTE the resync'd memory itself is NOT in this log — until the
+        next checkpoint covers it, a crash re-runs the resync (loud,
+        correct, wasteful — the documented window)."""
+        with self._io:
+            if self._file is None:
+                raise WalError("WAL not open")
+            self._file.close()
+            for _, path in self._list_segments():
+                with contextlib.suppress(OSError):
+                    os.unlink(path)
+            self._next_lsn = int(last_lsn) + 1
+            self.last_lsn = int(last_lsn)
+            self.synced_lsn = int(last_lsn)
+            self.last_body_crc = last_body_crc
+            self._dirty_records = 0
+            self._dirty_bytes = 0
+            self._open_segment_locked(self._next_lsn)
 
     # -- maintenance -------------------------------------------------------
 
@@ -969,6 +1182,44 @@ class WriteAheadLog:
             "lagRecords": self._dirty_records,
             "lagBytes": self._dirty_bytes,
         }
+
+
+# -- log shipping (cluster replication) -----------------------------------
+
+def iter_frames(data: bytes, algo: int):
+    """Walk a buffer of raw shipped frames, yielding (lsn, frame_bytes,
+    body) for each complete, checksum-valid frame and stopping at the
+    first truncated/invalid one (a reader racing the appender sees a
+    clean prefix, never garbage). `algo` is the sender's checksum
+    algorithm (its segment header / ship envelope); an unverifiable
+    algo (crc32c frames without the module) is walked structurally,
+    matching replay's applied-unverified behavior."""
+    crc_fn = _checksum_fn(algo)
+    off, n = 0, len(data)
+    while off + _FRAME.size <= n:
+        blen, body_crc, lsn, head_crc = _FRAME.unpack_from(data, off)
+        if crc_fn is not None and (crc_fn(
+                data[off:off + _FRAME_HEAD.size], 0)
+                & 0xFFFFFFFF) != head_crc:
+            return
+        if blen > MAX_RECORD_BYTES or off + _FRAME.size + blen > n:
+            return
+        body = data[off + _FRAME.size:off + _FRAME.size + blen]
+        if crc_fn is not None and \
+                (crc_fn(body, 0) & 0xFFFFFFFF) != body_crc:
+            return
+        yield lsn, data[off:off + _FRAME.size + blen], body
+        off += _FRAME.size + blen
+
+
+def build_frame(body: bytes, lsn: int) -> bytes:
+    """Frame one record body under THIS process's checksum algorithm —
+    re-framing shipped records whose sender used a different algo, and
+    framing resync/export record bodies for the ship envelope."""
+    body_crc = _write_crc(body, 0) & 0xFFFFFFFF
+    head = _FRAME_HEAD.pack(len(body), body_crc, lsn)
+    head_crc = _write_crc(head, 0) & 0xFFFFFFFF
+    return head + struct.pack("<I", head_crc) + body
 
 
 def orphan_segments(directory: str) -> List[str]:

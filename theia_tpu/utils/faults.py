@@ -11,8 +11,13 @@ and operators can drive real faults deterministically:
 
 Grammar (comma-separated entries):
 
-    entry       := site ":" mode [":" probability] ["@" nth]
+    entry       := site ["#" target] ":" mode [":" probability] ["@" nth]
     mode        := "error" | "hang"
+    target      := per-instance scoping: the rule fires only for hits
+                   whose ctx `peer` (or `target`) equals this value —
+                   `peer.partition#node2:error` severs only the node2
+                   link, the deterministic per-peer partition drill; a
+                   bare site matches every hit of that site
     probability := float in (0, 1]      (default 1.0; seeded RNG, so a
                                          given seed replays one firing
                                          pattern exactly)
@@ -42,6 +47,14 @@ Instrumented sites:
                       TRANSIENT_EXIT_CODE on an injected error so the
                       controller classifies it transient)
     reconciler.pass   DeclarativeReconciler.reconcile_once
+    net.send          cluster transport, before any bytes leave for a
+                      peer (replication shipping, ingest forwarding,
+                      heartbeats; ctx: peer, path)
+    net.recv          cluster API handler, on receipt of a peer's
+                      request before it is processed (ctx: peer, path)
+    peer.partition    both directions of one peer link: fired inside
+                      net.send AND net.recv, so arming it severs the
+                      link symmetrically — the network-partition drill
     admission.pressure  AdmissionController.admit, before any check:
                       "error" forces the admission plane to reject the
                       request (429 + Retry-After, reason "fault") —
@@ -174,19 +187,28 @@ class FaultInjector:
 
     def fire(self, site: str, **ctx: object) -> None:
         """One instrumented hit of `site`: count it, then inject per
-        the armed rule (no rule → free no-op)."""
+        the armed rule (no rule → free no-op). A rule armed with a
+        `site#target` key fires only when the hit's ctx `peer` (or
+        `target`) equals that target — hits and counters are tracked
+        under the targeted key, so `@nth` indexes per peer link."""
+        key = site
         rule = self.rules.get(site)
+        target = ctx.get("peer", ctx.get("target"))
+        if target is not None:
+            targeted = self.rules.get(f"{site}#{target}")
+            if targeted is not None:
+                key, rule = f"{site}#{target}", targeted
         if rule is None:
             return
         with self._lock:
-            n = self._counts[site] = self._counts.get(site, 0) + 1
+            n = self._counts[key] = self._counts.get(key, 0) + 1
             if rule.nth is not None:
                 if n != rule.nth:
                     return
             elif rule.probability < 1.0 and \
                     self._rng.random() >= rule.probability:
                 return
-        _M_FIRINGS.labels(site=site, mode=rule.mode).inc()
+        _M_FIRINGS.labels(site=key, mode=rule.mode).inc()
         if rule.mode == "hang":
             self._hang()
             return
