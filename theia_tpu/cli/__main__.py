@@ -682,7 +682,14 @@ def _parse_where(clause: str) -> dict:
 
 def query_cmd(args) -> None:
     """Run one filtered aggregation through POST /query and print the
-    result rows (the CLI face of the vectorized query engine)."""
+    result rows (the CLI face of the vectorized query engine).
+
+    Cluster-aware: --manager-addr takes a comma-separated endpoint
+    list, and the request rides the IngestClient failover/redirect
+    machinery — connection refusal / 5xx rotate endpoints, 307/308
+    re-target at the node named in Location — so the command works
+    against ANY node of a cluster, not just the one it was pointed
+    at."""
     doc: dict = {}
     if args.group_by:
         doc["groupBy"] = args.group_by
@@ -698,7 +705,17 @@ def query_cmd(args) -> None:
         doc["timeColumn"] = args.time_column
     if args.order_by:
         doc["orderBy"] = args.order_by
-    out = _request(args.manager_addr, "POST", "/query", doc)
+    from ..ingest.client import IngestClient, IngestError
+    addrs = [a.strip() for a in args.manager_addr.split(",")
+             if a.strip()]
+    try:
+        client = IngestClient(addrs, stream="cli-query",
+                              token=_TOKEN, ca_cert=_CA_CERT or None,
+                              max_attempts=4, backoff_base=0.2,
+                              backoff_cap=2.0)
+        out = client.request_json("POST", "/query", doc)
+    except IngestError as e:
+        raise APIError(f"error: {e}")
     if args.json:
         print(json.dumps(out, indent=2))
         return
@@ -707,12 +724,23 @@ def query_cmd(args) -> None:
         _print_table(rows, list(rows[0].keys()))
     else:
         print("no groups matched")
-    print(f"-- {out.get('groupCount', 0)} groups, "
-          f"{out.get('rowsScanned', 0):,} rows scanned, "
-          f"{out.get('partsScanned', 0)} parts scanned / "
-          f"{out.get('partsPruned', 0)} pruned, "
-          f"{out.get('engine')} engine, cache {out.get('cache')}, "
-          f"{out.get('tookMs', 0)} ms")
+    footer = (f"-- {out.get('groupCount', 0)} groups, "
+              f"{out.get('rowsScanned', 0):,} rows scanned, "
+              f"{out.get('partsScanned', 0)} parts scanned / "
+              f"{out.get('partsPruned', 0)} pruned, "
+              f"{out.get('engine')} engine, cache {out.get('cache')}, "
+              f"{out.get('tookMs', 0)} ms")
+    peers = out.get("peers")
+    if peers:
+        footer += (f"; cluster {peers.get('queried', 0)} peers "
+                   f"queried / {peers.get('pruned', 0)} pruned, "
+                   f"{out.get('bytesShipped', 0):,} partial bytes")
+    print(footer)
+    if out.get("partial"):
+        print(f"!! PARTIAL result — peers unavailable: "
+              f"{', '.join(out.get('missingPeers', []))} "
+              f"(answer covers the reachable nodes only)",
+              file=sys.stderr)
 
 
 # -- top (live rates from GET /metrics; no reference equivalent — the
@@ -857,6 +885,21 @@ def top(args) -> None:
                       f"{dscan / dt_q if dt_q > 0 else 0.0:,.0f} "
                       f"rows/s scanned, "
                       f"cache hit {hit_pct:.0f}%")
+                # distributed fan-out header (routing-mesh nodes):
+                # cumulative peers queried/pruned/failed — nonzero
+                # only where the coordinator actually runs
+                fanq = sample.get(
+                    ("theia_query_peers_queried_total", ()), 0.0)
+                fanp = sample.get(
+                    ("theia_query_peers_pruned_total", ()), 0.0)
+                fanf = sample.get(
+                    ("theia_query_peers_failed_total", ()), 0.0)
+                if fanq or fanp or fanf:
+                    fb = sample.get(
+                        ("theia_query_fanout_bytes_total", ()), 0.0)
+                    print(f"query fanout: {fanq:,.0f} peers queried, "
+                          f"{fanp:,.0f} pruned, {fanf:,.0f} failed, "
+                          f"{fb / 1e3:,.1f} KB partials shipped")
             qd = sample.get(("theia_fused_queue_depth", ()))
             if qd is not None:
                 # fused-engine header: pipeline backlog + step rate +
@@ -903,7 +946,9 @@ def build_parser() -> argparse.ArgumentParser:
         prog="theia", description="theia-tpu command line tool")
     p.add_argument("--manager-addr", default=get_manager_addr(DEFAULT_ADDR),
                    help="theia-manager API address (env "
-                        "THEIA_MANAGER_ADDR overrides the default)")
+                        "THEIA_MANAGER_ADDR overrides the default); "
+                        "`theia query` accepts a comma-separated "
+                        "endpoint list and fails over across it")
     p.add_argument("--ca-cert", default="",
                    help="CA certificate for a TLS manager (the "
                         "published theia-ca.crt)")

@@ -110,6 +110,9 @@ class ClusterMap:
         #: peer -> (last success monotonic, last ping doc)
         self._seen: Dict[str, Tuple[float, Dict[str, object]]] = {}
         self._last_err: Dict[str, str] = {}
+        #: liveness-transition counter (see membership_epoch)
+        self._epoch = 0
+        self._alive_snap: Optional[Tuple[str, ...]] = None
 
     def others(self) -> List[str]:
         return [p for p in self.order if p != self.self_id]
@@ -149,6 +152,20 @@ class ClusterMap:
 
     def alive(self) -> List[str]:
         return [p for p in self.order if self.is_alive(p)]
+
+    def membership_epoch(self) -> int:
+        """Monotone counter of OBSERVED liveness transitions: any peer
+        flipping alive ↔ down since the last call bumps it, so "the
+        membership changed" is one integer comparison — the cluster
+        query cache keys on it (query/distributed.py), and a peer
+        coming back structurally invalidates every cached
+        partial-coverage decision."""
+        current = tuple(self.alive())
+        with self._lock:
+            if current != self._alive_snap:
+                self._alive_snap = current
+                self._epoch += 1
+            return self._epoch
 
     def peer_info(self, peer: str) -> Dict[str, object]:
         with self._lock:

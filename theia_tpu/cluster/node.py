@@ -31,7 +31,10 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Callable, Dict, List, Optional
+
+from ..utils.env import env_float
 
 from ..utils.logging import get_logger
 from .membership import (
@@ -80,6 +83,7 @@ class ClusterNode:
                  ca_cert: Optional[str] = None,
                  acks: Optional[str] = None,
                  heartbeat_interval: Optional[float] = None,
+                 query_engine=None,
                  clock=None) -> None:
         spec = (peers if peers is not None
                 else os.environ.get("THEIA_CLUSTER_PEERS", ""))
@@ -100,6 +104,15 @@ class ClusterNode:
         self._acks = acks
         self.term = 1
         self.token = token
+        # Scatter-gather read path: heartbeats piggyback this node's
+        # store fingerprint + time bounds so coordinators can cache
+        # and prune (query/distributed.py). Optional — a node without
+        # a query engine just pings without the store doc.
+        self.query_engine = query_engine
+        self._store_doc_cache: Optional[Dict[str, object]] = None
+        self._store_doc_at = 0.0
+        self._bounds_interval = env_float(
+            "THEIA_CLUSTER_BOUNDS_INTERVAL", 5.0)
         self.transport = ClusterTransport(self.cmap, token=token,
                                           ca_cert=ca_cert)
         self._lock = threading.Lock()
@@ -167,6 +180,7 @@ class ClusterNode:
             self.leader.stop()
         if self.router is not None:
             self.router.close()
+        self.transport.close()
 
     # -- ingest-path hooks -------------------------------------------------
 
@@ -225,6 +239,60 @@ class ClusterNode:
         hs = getattr(self.db, "wal_handshake", None)
         if callable(hs):
             doc["wal"] = hs()
+        if self.query_engine is not None:
+            sd = self._store_ping_doc()
+            if sd is not None:
+                doc["store"] = sd
+        return doc
+
+    def _store_ping_doc(self) -> Optional[Dict[str, object]]:
+        """Heartbeat piggyback for the scatter-gather read path: the
+        CURRENT store fingerprint (coordinators key their cluster
+        result cache on it — any insert/seal/merge here invalidates
+        them within one heartbeat) plus per-table time bounds and row
+        count (coordinators prune peers whose data cannot overlap a
+        query window). The fingerprint is always fresh; the bounds
+        scan is throttled to THEIA_CLUSTER_BOUNDS_INTERVAL — while a
+        store is actively changing inside the throttle window only
+        the bare fingerprint ships, so stale-narrow bounds can never
+        wrongly prune this node."""
+        try:
+            fp = self.query_engine.fingerprint_hash()
+        except Exception:
+            return None   # e.g. every replica down: peers skip pruning
+        cached = self._store_doc_cache
+        if cached is not None and cached.get("fingerprint") == fp:
+            return cached
+        now = time.monotonic()
+        if cached is not None and \
+                now - self._store_doc_at < self._bounds_interval:
+            return {"fingerprint": fp}
+        doc: Dict[str, object] = {"fingerprint": fp}
+        try:
+            rows = 0
+            tabs: List[Dict[str, tuple]] = []
+            for t in self.query_engine._tables():
+                n = len(t)
+                rows += n
+                if n:
+                    tb = getattr(t, "time_bounds", None)
+                    tabs.append(tb() if callable(tb) else {})
+            doc["rows"] = rows
+            # a column's bounds are only safe when EVERY non-empty
+            # table reported it — a shard with unknown bounds could
+            # hold rows outside the others' range
+            bounds: Dict[str, List[int]] = {}
+            if tabs:
+                for col in tabs[0]:
+                    if all(col in tb for tb in tabs):
+                        bounds[col] = [
+                            int(min(tb[col][0] for tb in tabs)),
+                            int(max(tb[col][1] for tb in tabs))]
+            doc["bounds"] = bounds
+        except Exception as e:
+            logger.v(1).info("store bounds scan failed: %s", e)
+        self._store_doc_cache = doc
+        self._store_doc_at = now
         return doc
 
     def current_term(self) -> int:

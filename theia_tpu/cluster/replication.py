@@ -202,8 +202,20 @@ class ReplicationLeader:
         self.ack_timeout = (env_float("THEIA_REPL_ACK_TIMEOUT", 10.0)
                             if ack_timeout is None
                             else float(ack_timeout))
-        self.ship_bytes = (env_int("THEIA_REPL_SHIP_BYTES", 1 << 20)
-                           if ship_bytes is None else int(ship_bytes))
+        if ship_bytes is None:
+            # frames ship in batched POSTs up to this budget: every
+            # frame pending when the shipper wakes rides ONE request
+            # (one connection-pool roundtrip, one follower fsync),
+            # which is what turns concurrent producers into larger
+            # ship batches instead of more roundtrips. The old
+            # THEIA_REPL_SHIP_BYTES spelling is honored for
+            # deployments that pinned it.
+            legacy = os.environ.get("THEIA_REPL_SHIP_BYTES")
+            self.ship_bytes = (
+                int(legacy) if legacy
+                else env_int("THEIA_REPL_BATCH_BYTES", 256 << 10))
+        else:
+            self.ship_bytes = int(ship_bytes)
         self.idle_wait = idle_wait
         self.dedup_dump = dedup_dump
         self._clock = clock

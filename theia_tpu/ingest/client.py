@@ -258,6 +258,82 @@ class IngestClient:
             f"batch seq={seq} not acknowledged after "
             f"{self.max_attempts} attempts (last: {last})")
 
+    def request_json(self, method: str, path: str,
+                     doc: Optional[Dict] = None,
+                     timeout: Optional[float] = None
+                     ) -> Dict[str, object]:
+        """One JSON API request under the SAME endpoint-failover /
+        redirect / backoff machinery as `send()` — so a CLI verb (the
+        `theia query` read path) works against ANY cluster node:
+        connection refusal and 5xx rotate endpoints, 429 honors
+        Retry-After, 307/308 re-target at the node named in Location.
+        Unlike `send()` this carries no ingest ledger or seq contract;
+        it is for idempotent control/read calls."""
+        payload = (json.dumps(doc).encode() if doc is not None
+                   else None)
+        headers = {"Content-Type": "application/json"}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
+        last: Optional[str] = None
+        redirects_left = len(self.addrs) + 4
+        for attempt in range(1, self.max_attempts + 1):
+            try:
+                req = urllib.request.Request(
+                    self.addr + path, method=method, data=payload,
+                    headers=headers)
+                with urllib.request.urlopen(
+                        req, timeout=timeout or self.timeout,
+                        context=self._ctx) as resp:
+                    raw = resp.read()
+                return json.loads(raw) if raw else {}
+            except urllib.error.HTTPError as e:
+                body = e.read().decode(errors="replace")
+                if e.code in (307, 308):
+                    loc = e.headers.get("Location", "")
+                    redirects_left -= 1
+                    if redirects_left >= 0 and self._redirect_to(loc):
+                        logger.v(1).info("%s %s redirected to %s",
+                                         method, path, self.addr)
+                        continue
+                    raise IngestError(
+                        f"{method} {path} redirect refused "
+                        f"(Location {loc!r}: unusable or a loop)")
+                if e.code == 429:
+                    self.rejected += 1
+                    delay = (parse_retry_after(e.headers, body)
+                             + jittered_backoff(self.backoff_base,
+                                                self.backoff_cap,
+                                                attempt, self._rng))
+                    last = f"429: {body[:200]}"
+                elif e.code >= 500:
+                    self.retries += 1
+                    delay = jittered_backoff(self.backoff_base,
+                                             self.backoff_cap,
+                                             attempt, self._rng)
+                    last = f"{e.code}: {body[:200]}"
+                    self._fail_over()
+                else:
+                    raise IngestError(
+                        f"{method} {path} failed ({e.code}): "
+                        f"{body[:500]}")
+            except (OSError, http.client.HTTPException) as e:
+                self.retries += 1
+                delay = jittered_backoff(self.backoff_base,
+                                         self.backoff_cap, attempt,
+                                         self._rng)
+                last = (f"unreachable: "
+                        f"{getattr(e, 'reason', None) or e!r}")
+                self._fail_over()
+            if attempt >= self.max_attempts:
+                break
+            logger.v(1).info(
+                "%s %s attempt %d/%d: %s; retrying in %.2fs",
+                method, path, attempt, self.max_attempts, last, delay)
+            self._sleep(delay)
+        raise IngestError(
+            f"{method} {path} not answered after "
+            f"{self.max_attempts} attempts (last: {last})")
+
     def summary(self) -> Dict[str, object]:
         return {
             "stream": self.stream,

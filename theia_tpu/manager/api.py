@@ -204,6 +204,12 @@ class SupportBundleManager(AsyncCollector):
 
 class ManagerAPIHandler(BaseHTTPRequestHandler):
     server_version = f"theia-tpu-manager/{__version__}"
+    # HTTP/1.1: keep-alive, so the cluster transport's persistent
+    # per-peer connections (heartbeats at 1 Hz, a frame ship per
+    # ingest batch, a partial per distributed query) actually reuse
+    # sockets instead of paying a TCP handshake each. Every response
+    # path sends Content-Length (the 1.1 framing contract).
+    protocol_version = "HTTP/1.1"
     controller: JobController
     stats: StatsProvider
     bundles: SupportBundleManager
@@ -212,6 +218,7 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
     retention = None  # RetentionLoop
     maintenance = None  # PartMaintenanceLoop (parts engine)
     queries = None    # QueryEngine
+    distqueries = None  # ClusterQueryCoordinator (routing mesh)
     cluster = None    # ClusterNode (multi-node tier)
     auth_token: Optional[str] = None
     quiet = True
@@ -219,6 +226,11 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
     # declares a Content-Length then stalls mid-body would otherwise
     # hold a worker thread forever (slow-loris).
     timeout = 120
+    # A response is two small send()s (headers, body); on a
+    # keep-alive connection Nagle + the client's delayed ACK would
+    # stall each by ~40ms — fatal for the cluster's persistent
+    # peer links (heartbeats, frame ships, query partials).
+    disable_nagle_algorithm = True
 
     def log_message(self, fmt, *args):  # noqa: N802
         logger.v(2).info("%s %s", self.address_string(), fmt % args)
@@ -236,6 +248,11 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
         self.wfile.write(raw)
 
     def _send_error_json(self, code: int, message: str) -> None:
+        # Error paths can fire BEFORE the request body was consumed
+        # (auth, Content-Length validation, armed recv-side faults);
+        # under HTTP/1.1 keep-alive the unread body bytes would be
+        # parsed as the next request line — close instead of desync.
+        self.close_connection = True
         self._send_json({"kind": "Status", "status": "Failure",
                          "message": message, "code": code}, code)
 
@@ -244,6 +261,7 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
         RFC 9110; the JSON body carries the precise float for clients
         that can use it)."""
         import math
+        self.close_connection = True   # body may be unconsumed
         raw = json.dumps({
             "kind": "Status", "status": "Failure", "message": str(e),
             "reason": e.reason, "code": 429,
@@ -277,6 +295,7 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
             raise AuthError(403, "invalid bearer token")
 
     def _send_auth_error(self, e: AuthError) -> None:
+        self.close_connection = True   # body was never consumed
         raw = json.dumps({"kind": "Status", "status": "Failure",
                           "message": str(e), "code": e.code}).encode()
         self.send_response(e.code)
@@ -321,6 +340,7 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
 
     def do_GET(self) -> None:  # noqa: N802
         from ..cluster import StaleReadError
+        from ..query import IncompleteResultError
         from .admission import AdmissionRejected
         try:
             self._get()
@@ -333,6 +353,10 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
         except StaleReadError as e:
             # bounded-staleness follower read over budget: retryable
             # here after catch-up, or read from the leader
+            self._send_error_json(503, str(e))
+        except IncompleteResultError as e:
+            # THEIA_QUERY_STRICT=1: a distributed query missing peers
+            # refuses rather than answer partial — retry after heal
             self._send_error_json(503, str(e))
         except AllReplicasDownError as e:
             # "retry later", not "server bug": every store copy is out
@@ -350,6 +374,7 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
             ReplicationLagError,
             RouterForwardError,
         )
+        from ..query import IncompleteResultError
         from .admission import AdmissionRejected
         from .ingest import StreamCapacityError
         try:
@@ -364,7 +389,8 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
             # distinct from 503 (the store itself is unavailable)
             self._send_retry_after(e)
         except (StreamCapacityError, AllReplicasDownError,
-                ReplicationLagError, RouterForwardError) as e:
+                ReplicationLagError, RouterForwardError,
+                IncompleteResultError) as e:
             # retryable capacity/availability condition, not a client
             # payload error: quorum not met, owner unreachable, every
             # replica down — the producer's retry is dedup-idempotent
@@ -425,7 +451,10 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
             # admission pressure ladder (heavy reads shed at the
             # shed_detector rung, 429 + Retry-After).
             self._require_auth()
-            self._serve_query(self._plan_from_get())
+            self._serve_query(
+                self._plan_from_get(),
+                use_cache=self._cache_flag(
+                    self._query().get("cache", "1")))
             return
         if parts == ("cluster", "ping"):
             # peer liveness + log-matching handshake; open (the
@@ -589,7 +618,11 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
         # tests don't carry every binding)
         queries = getattr(self, "queries", None)
         if queries is not None:
-            doc["query"] = queries.stats()
+            qdoc = queries.stats()
+            dist = getattr(self, "distqueries", None)
+            if dist is not None:
+                qdoc["distributed"] = dist.stats()
+            doc["query"] = qdoc
         # Storage engine + tier summary (parts engine: part counts,
         # hot/cold bytes, memtable, merge/seal/demote totals). The
         # attribute lookup itself can raise on a replicated store with
@@ -764,10 +797,19 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
         from ..query import plan_from_params
         return plan_from_params(self._query())
 
-    def _serve_query(self, plan) -> None:
+    @staticmethod
+    def _cache_flag(raw) -> bool:
+        """`cache=0|false|no` (GET param / POST body key) bypasses the
+        result cache for one query — the bench's timed windows measure
+        execution, not cache hits."""
+        return str(raw).strip().lower() not in ("0", "false", "no")
+
+    def _serve_query(self, plan, use_cache: bool = True) -> None:
         """Shared GET/POST /query tail: admission, execution, timing
         headers. 400s (PlanError is a ValueError) and 429s surface
-        through the verb handlers' taxonomy."""
+        through the verb handlers' taxonomy. On a routing-mesh node
+        the query coordinator scatter-gathers the whole cluster;
+        everywhere else the local engine answers."""
         if self.queries is None:
             raise KeyError(self.path)
         if self.cluster is not None:
@@ -778,7 +820,9 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
             if self.ingest is not None else None
         if adm is not None:
             adm.admit_query()
-        self._send_json(self.queries.execute(plan))
+        dist = getattr(self, "distqueries", None)
+        engine = dist if dist is not None else self.queries
+        self._send_json(engine.execute(plan, use_cache=use_cache))
 
     def _send_ingest_redirect(self) -> None:
         """307 + Location at the current leader: this node is a
@@ -806,7 +850,13 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
         parts = self._route()
         if parts == ("query",):
             from ..query import parse_plan
-            self._serve_query(parse_plan(self._read_body()))
+            body = self._read_body()
+            self._serve_query(
+                parse_plan(body),
+                use_cache=self._cache_flag(body.get("cache", "1")))
+            return
+        if parts == ("query", "partial"):
+            self._post_query_partial()
             return
         if parts == ("ingest",):
             if self.cluster is not None and \
@@ -853,6 +903,37 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
                 float(body.get("durationSeconds", 3.0) or 3.0)), 201)
             return
         raise KeyError(self.path)
+
+    def _post_query_partial(self) -> None:
+        """Cluster-internal scatter-gather server half: execute the
+        posted plan over the LOCAL store only and answer mergeable
+        per-group partial aggregates as one binary TQPF frame (group
+        keys + lowered count/sum/min/max columns — never rows).
+        Token-gated like every POST; admission rides one rung ahead
+        of ingest HERE TOO, so a shed peer answers 429 and the
+        coordinator degrades to partial:true; the recv-side fault
+        hook makes partition drills sever the read path
+        symmetrically."""
+        from ..cluster.transport import NODE_HEADER, fire_recv
+        from ..query import parse_plan
+        from ..query.distributed import serve_partial
+        if self.queries is None:
+            raise KeyError(self.path)
+        fire_recv(self.headers.get(NODE_HEADER), "/query/partial")
+        body = self._read_body()
+        plan = parse_plan(body.get("plan") or {})
+        adm = getattr(self.ingest, "admission", None) \
+            if self.ingest is not None else None
+        if adm is not None:
+            adm.admit_query()
+        node_id = (self.cluster.cmap.self_id
+                   if self.cluster is not None else "")
+        raw = serve_partial(self.queries, plan, node_id=node_id)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(len(raw)))
+        self.end_headers()
+        self.wfile.write(raw)
 
     def _post_cluster(self, parts) -> None:
         """Cluster control/replication plane (token-gated with every
@@ -926,10 +1007,43 @@ class _TLSCapableServer(ThreadingHTTPServer):
     """HTTP server that performs the TLS handshake per connection on
     the worker thread — wrapping the *listening* socket would run the
     handshake inside accept() on the serve_forever thread, letting one
-    silent client stall the entire API."""
+    silent client stall the entire API.
+
+    Live connections are tracked so `server_close()` can SEVER them:
+    with HTTP/1.1 keep-alive (the cluster transport's persistent
+    per-peer connections) a handler thread otherwise keeps serving an
+    established socket long after the listening socket closed — a
+    shut-down node must go dark, not half-dark."""
 
     ssl_context = None
     handshake_timeout = 10.0
+
+    def __init__(self, *args, **kwargs) -> None:
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+        super().__init__(*args, **kwargs)
+
+    def process_request(self, request, client_address):
+        with self._conns_lock:
+            self._conns.add(request)
+        super().process_request(request, client_address)
+
+    def shutdown_request(self, request):
+        with self._conns_lock:
+            self._conns.discard(request)
+        super().shutdown_request(request)
+
+    def server_close(self):
+        super().server_close()
+        import socket as _socket
+        with self._conns_lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for c in conns:
+            try:
+                c.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
 
     def finish_request(self, request, client_address):
         if self.ssl_context is not None:
@@ -1027,6 +1141,7 @@ class TheiaManagerServer:
         # entirely without a peer list — single-node managers carry
         # zero cluster overhead.
         self.cluster = None
+        self.distqueries = None
         peers_spec = (cluster_peers
                       if cluster_peers is not None
                       else _os.environ.get("THEIA_CLUSTER_PEERS", ""))
@@ -1035,7 +1150,18 @@ class TheiaManagerServer:
             self.cluster = ClusterNode(
                 db, self.ingest, peers=peers_spec,
                 self_id=cluster_self, role=cluster_role,
-                acks=cluster_acks, token=self.auth_token or "")
+                acks=cluster_acks, token=self.auth_token or "",
+                query_engine=self.queries)
+            # Scatter-gather /query on the routing mesh: data is
+            # spread by destination hash, so the receiving node
+            # coordinates a cluster-wide answer (leader/follower
+            # topologies replicate the whole store — their local
+            # engine already answers cluster-wide).
+            if self.cluster.role == "peer" and \
+                    len(self.cluster.cmap.order) > 1:
+                from ..query import ClusterQueryCoordinator
+                self.distqueries = ClusterQueryCoordinator(
+                    self.cluster, self.queries)
             # wired unconditionally: the gate checks the node's role
             # at CALL time, so a follower promoted to leader later
             # starts enforcing the quorum without rewiring
@@ -1055,6 +1181,7 @@ class TheiaManagerServer:
             "retention": self.retention,
             "maintenance": self.maintenance,
             "queries": self.queries,
+            "distqueries": self.distqueries,
             "cluster": self.cluster,
             "auth_token": self.auth_token,
         })

@@ -4,18 +4,24 @@
 part-natively (pruned, encoded-space filters, late-materializing
 group-by, bounded-pool parallelism, cold streaming, result cache),
 `kernels.py` holds the aggregation kernels (numpy reduceat / jitted
-jnp segment reductions), and `reference.py` is the slow-but-correct
-oracle the whole path is gated against.
+jnp segment reductions), `reference.py` is the slow-but-correct
+oracle the whole path is gated against, and `distributed.py` is the
+cluster scatter-gather tier (coordinator fan-out over
+`/query/partial`, mergeable TQPF partial frames, peer pruning,
+cluster-fingerprint caching).
 """
 
-from .engine import QueryCache, QueryEngine, QueryError
+from .distributed import ClusterQueryCoordinator, IncompleteResultError
+from .engine import (QueryCache, QueryEngine, QueryError,
+                     merge_materialized)
 from .kernels import kernel_mode
 from .plan import (AGG_OPS, Aggregate, Filter, PlanError, QueryPlan,
                    parse_plan, plan_from_params)
 from .reference import reference_execute
 
 __all__ = [
-    "AGG_OPS", "Aggregate", "Filter", "PlanError", "QueryCache",
-    "QueryEngine", "QueryError", "QueryPlan", "kernel_mode",
+    "AGG_OPS", "Aggregate", "ClusterQueryCoordinator", "Filter",
+    "IncompleteResultError", "PlanError", "QueryCache", "QueryEngine",
+    "QueryError", "QueryPlan", "kernel_mode", "merge_materialized",
     "parse_plan", "plan_from_params", "reference_execute",
 ]

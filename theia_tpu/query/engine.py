@@ -48,6 +48,7 @@ suite (tests/test_query.py) holds every path bit-identical.
 from __future__ import annotations
 
 import collections
+import hashlib
 import os
 import threading
 import time
@@ -341,6 +342,14 @@ class QueryEngine:
             tables = self._tables()
         return tuple(self._table_state(t) for t in tables)
 
+    def fingerprint_hash(self) -> str:
+        """Compact digest of `fingerprint()` — what cluster heartbeats
+        piggyback so a query coordinator can key its cluster-wide
+        result cache on per-peer store states (any seal/merge/demote/
+        insert/delete on any node moves its digest)."""
+        return hashlib.sha1(
+            repr(self.fingerprint()).encode()).hexdigest()[:16]
+
     # -- public API --------------------------------------------------------
 
     def execute(self, plan: QueryPlan,
@@ -370,12 +379,7 @@ class QueryEngine:
                 return doc
             _M_CACHE_MISSES.inc()
         stats = {"rowsScanned": 0, "partsScanned": 0, "partsPruned": 0}
-        table_results = [self._execute_table(plan, t, stats)
-                         for t in tables]
-        if len(table_results) == 1:
-            keys, aggs = table_results[0]
-        else:
-            keys, aggs = self._merge_materialized(plan, table_results)
+        keys, aggs = self._partial_for_tables(plan, tables, stats)
         if aggs is None or _n_groups(aggs) == 0:
             rows, groups = empty_result(plan)
         else:
@@ -412,7 +416,31 @@ class QueryEngine:
             "cache": self.cache.stats(),
         }
 
+    def execute_partial(self, plan: QueryPlan,
+                        stats: Optional[Dict[str, int]] = None
+                        ) -> Tuple[Optional[List[np.ndarray]],
+                                   Optional[Dict[str, np.ndarray]]]:
+        """One node's share of a distributed query: (materialized
+        group-key columns, merged LOWERED aggregates) over the local
+        store only — the `/query/partial` server half. No finalize, no
+        top-K, no cache: partials must merge exactly on the
+        coordinator, and the top-K cut is only correct after that
+        merge (query/distributed.py)."""
+        if stats is None:
+            stats = {"rowsScanned": 0, "partsScanned": 0,
+                     "partsPruned": 0}
+        return self._partial_for_tables(plan, self._tables(), stats)
+
     # -- per-table execution -----------------------------------------------
+
+    def _partial_for_tables(self, plan: QueryPlan, tables, stats
+                            ) -> Tuple[Optional[List[np.ndarray]],
+                                       Optional[Dict[str, np.ndarray]]]:
+        table_results = [self._execute_table(plan, t, stats)
+                         for t in tables]
+        if len(table_results) == 1:
+            return table_results[0]
+        return merge_materialized(plan, table_results)
 
     def _execute_table(self, plan: QueryPlan, table, stats
                        ) -> Tuple[Optional[List[np.ndarray]],
@@ -630,55 +658,59 @@ class QueryEngine:
                   for c in value_columns(specs)}
         return kernels.aggregate(keys, values, specs)
 
-    # -- cross-table merge (sharded stores) --------------------------------
 
-    def _merge_materialized(self, plan, table_results
-                            ) -> Tuple[Optional[List[np.ndarray]],
-                                       Optional[Dict[str, np.ndarray]]]:
-        """Shards own independent dictionaries, so cross-shard merging
-        happens in MATERIALIZED key space: fold each shard's
-        (decoded keys, aggregates) into one dict keyed by the group
-        tuple."""
-        specs = lower_specs(plan)
-        acc: Dict[tuple, List[int]] = {}
-        for keys, aggs in table_results:
-            if aggs is None:
+# -- cross-store merge (sharded stores, cluster partials) ------------------
+
+def merge_materialized(plan, table_results
+                       ) -> Tuple[Optional[List[np.ndarray]],
+                                  Optional[Dict[str, np.ndarray]]]:
+    """Shards — and cluster peers — own independent dictionaries, so
+    cross-store merging happens in MATERIALIZED key space: fold each
+    partial's (decoded keys, lowered aggregates) into one dict keyed
+    by the group tuple. Count/sum partials merge via sum, min via min,
+    max via max — exactly, in int64 — so the merged result is
+    bit-identical to a single-store execution over the union of the
+    rows."""
+    specs = lower_specs(plan)
+    acc: Dict[tuple, List[int]] = {}
+    for keys, aggs in table_results:
+        if aggs is None:
+            continue
+        g = _n_groups(aggs)
+        for i in range(g):
+            kt = tuple(
+                (k[i].item() if isinstance(k[i], np.generic)
+                 else k[i]) for k in keys) if keys else ()
+            vals = acc.get(kt)
+            if vals is None:
+                acc[kt] = [int(aggs[label][i])
+                           for label, _, _ in specs]
                 continue
-            g = _n_groups(aggs)
-            for i in range(g):
-                kt = tuple(
-                    (k[i].item() if isinstance(k[i], np.generic)
-                     else k[i]) for k in keys) if keys else ()
-                vals = acc.get(kt)
-                if vals is None:
-                    acc[kt] = [int(aggs[label][i])
-                               for label, _, _ in specs]
-                    continue
-                for j, (label, op, _) in enumerate(specs):
-                    v = int(aggs[label][i])
-                    if kernels.MERGE_OP[op] == "sum":
-                        vals[j] += v
-                    elif kernels.MERGE_OP[op] == "min":
-                        vals[j] = min(vals[j], v)
-                    else:
-                        vals[j] = max(vals[j], v)
-        if not acc:
-            return None, None
-        keys_out: List[np.ndarray] = []
-        ordered = list(acc.keys())
-        for j in range(len(plan.group_by)):
-            vals = [kt[j] for kt in ordered]
-            # numeric group keys must stay int64 — an object array
-            # would make finalize's tie-break compare them as STRINGS
-            # ('80' < '9'), diverging from the single-table engines
-            if all(isinstance(v, (int, np.integer)) for v in vals):
-                keys_out.append(np.asarray(vals, np.int64))
-            else:
-                keys_out.append(np.asarray(vals, dtype=object))
-        aggs_out = {
-            label: np.asarray([acc[kt][j] for kt in ordered], np.int64)
-            for j, (label, _, _) in enumerate(specs)}
-        return keys_out, aggs_out
+            for j, (label, op, _) in enumerate(specs):
+                v = int(aggs[label][i])
+                if kernels.MERGE_OP[op] == "sum":
+                    vals[j] += v
+                elif kernels.MERGE_OP[op] == "min":
+                    vals[j] = min(vals[j], v)
+                else:
+                    vals[j] = max(vals[j], v)
+    if not acc:
+        return None, None
+    keys_out: List[np.ndarray] = []
+    ordered = list(acc.keys())
+    for j in range(len(plan.group_by)):
+        vals = [kt[j] for kt in ordered]
+        # numeric group keys must stay int64 — an object array
+        # would make finalize's tie-break compare them as STRINGS
+        # ('80' < '9'), diverging from the single-table engines
+        if all(isinstance(v, (int, np.integer)) for v in vals):
+            keys_out.append(np.asarray(vals, np.int64))
+        else:
+            keys_out.append(np.asarray(vals, dtype=object))
+    aggs_out = {
+        label: np.asarray([acc[kt][j] for kt in ordered], np.int64)
+        for j, (label, _, _) in enumerate(specs)}
+    return keys_out, aggs_out
 
 
 def _n_groups(aggs: Dict[str, np.ndarray]) -> int:

@@ -388,6 +388,31 @@ class Table:
             self.generation += 1
         return int(mask.sum())
 
+    #: columns whose (min, max) the cluster heartbeat piggybacks so a
+    #: query coordinator can prune peers against a plan's time window
+    TIME_BOUND_COLUMNS = ("timeInserted", "flowStartSeconds",
+                          "flowEndSeconds")
+
+    def time_bounds(self, columns: Sequence[str] = TIME_BOUND_COLUMNS
+                    ) -> Dict[str, Tuple[int, int]]:
+        """{column: (min, max)} over the resident rows for the
+        standard query-window columns — the heartbeat piggyback behind
+        cluster peer pruning (query/distributed.py). On this flat
+        engine it is an O(rows) numpy scan, so the caller throttles
+        (THEIA_CLUSTER_BOUNDS_INTERVAL); PartTable overrides with its
+        resident part metadata. Columns absent from the schema (or an
+        empty table) are omitted — 'unknown', never 'empty range'."""
+        with self._lock:
+            batches = list(self._batches)
+        out: Dict[str, Tuple[int, int]] = {}
+        for col in columns:
+            pairs = [(int(b[col].min()), int(b[col].max()))
+                     for b in batches if col in b and len(b)]
+            if pairs:
+                out[col] = (min(p[0] for p in pairs),
+                            max(p[1] for p in pairs))
+        return out
+
     def min_value(self, column: str = "timeInserted") -> Optional[int]:
         """Min over a column without concatenating (None when empty).
         For the time column this is an O(batches) walk over cached

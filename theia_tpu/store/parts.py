@@ -916,6 +916,40 @@ class PartTable(Table):
                 self.generation += 1
         return deleted
 
+    def time_bounds(self, columns=Table.TIME_BOUND_COLUMNS):
+        """{column: (min, max)} from resident part metadata plus the
+        (small) memtable — O(parts) per call, the cluster-heartbeat
+        piggyback. A part missing metadata for a column makes that
+        column unknown (omitted): peer pruning must never act on a
+        bound that does not cover every row."""
+        with self._lock:
+            parts = list(self._parts)
+            mem = list(self._batches)
+        out = {}
+        for col in columns:
+            lo: Optional[int] = None
+            hi: Optional[int] = None
+            known = True
+            for p in parts:
+                mm = p.minmax.get(col)
+                if mm is None:
+                    known = False
+                    break
+                lo = mm[0] if lo is None else min(lo, mm[0])
+                hi = mm[1] if hi is None else max(hi, mm[1])
+            if not known:
+                continue
+            for b in mem:
+                if col in b and len(b):
+                    a = b[col]
+                    lo = (int(a.min()) if lo is None
+                          else min(lo, int(a.min())))
+                    hi = (int(a.max()) if hi is None
+                          else max(hi, int(a.max())))
+            if lo is not None:
+                out[col] = (int(lo), int(hi))
+        return out
+
     def min_value(self, column: str = "timeInserted") -> Optional[int]:
         """O(parts) from metadata for pruning columns; decode fallback
         otherwise."""
