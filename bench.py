@@ -95,6 +95,58 @@ def _run_child(env: dict, timeout_s: float):
     return out, (None if out else "no output (rc=0)")
 
 
+def _parse_args(argv=None):
+    import argparse
+    p = argparse.ArgumentParser(
+        description="theia-tpu benchmark driver (one JSON result "
+                    "line on stdout, whatever happens)")
+    p.add_argument("--out", default="",
+                   help="also write the result as a schema-versioned "
+                        "JSON artifact (host metadata + per-leg "
+                        "values) to this path — reproducible "
+                        "BENCH_*.json instead of numbers living in "
+                        "changelog prose")
+    return p.parse_args(argv)
+
+
+def _write_artifact(path: str, result: dict) -> None:
+    """Schema-versioned bench artifact: the result dict plus enough
+    host metadata to interpret (or distrust) the numbers later."""
+    import datetime
+    import platform
+    import socket
+    doc = {
+        "schemaVersion": 1,
+        "createdAt": datetime.datetime.now(
+            datetime.timezone.utc).isoformat(),
+        "host": {
+            "hostname": socket.gethostname(),
+            "cpus": os.cpu_count(),
+            "platform": platform.platform(),
+            "python": platform.python_version(),
+        },
+        # knobs only, never credentials: the artifact is meant to be
+        # committed/shared (THEIA_TOKEN / THEIA_AUTH_TOKEN carry the
+        # deployment's service secret)
+        "env": {k: v for k, v in sorted(os.environ.items())
+                if k.startswith(("THEIA_", "JAX_"))
+                and not any(s in k for s in
+                            ("TOKEN", "SECRET", "KEY", "PASSWORD"))},
+        "result": result,
+    }
+    try:
+        import jax
+        doc["host"]["jax"] = jax.__version__
+    except Exception:
+        pass
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    print(f"bench artifact written to {path}", file=sys.stderr)
+
+
 def main() -> None:
     """Always prints exactly one JSON result line on stdout, whatever
     fails or HANGS. The orchestrator (this function) owns no JAX state;
@@ -105,6 +157,7 @@ def main() -> None:
     if os.environ.get("THEIA_BENCH_INNER") == "1":
         print(json.dumps(run_benchmarks()))
         return
+    args = _parse_args()
     _kill_strays()
     # Device-attempt budget: THEIA_BENCH_DEVICE_TIMEOUT wins (BENCH_r05
     # burned 2x420s before degrading; a host that knows its accelerator
@@ -176,6 +229,11 @@ def main() -> None:
             "degraded_reason": degraded_reason
             or "all backends failed",
         }).encode()
+    if args.out:
+        try:
+            _write_artifact(args.out, json.loads(out))
+        except Exception as e:
+            print(f"bench artifact write failed: {e}", file=sys.stderr)
     sys.stdout.buffer.write(out + b"\n")
     sys.stdout.flush()
 
@@ -820,6 +878,68 @@ def run_benchmarks() -> dict:
                   f"{metrics_overhead_pct}%)", file=sys.stderr)
     except Exception as e:
         print(f"metrics-overhead bench skipped: {e}", file=sys.stderr)
+
+    # Distributed-tracing overhead: the SAME IngestManager A/B shape
+    # as the metrics leg, flipping THEIA_TRACE_SAMPLE 0 ↔ 1 — with
+    # sampling off no trace context is minted and no header ships, so
+    # the delta is the whole cost of sampled tracing on the e2e
+    # ingest path (the parity budget: within host noise, ≪ 3%).
+    tracing_overhead_pct = None
+    try:
+        import contextlib
+
+        from theia_tpu.ingest import BlockEncoder, native_available
+        from theia_tpu.manager.ingest import IngestManager
+        from theia_tpu.store import FlowDatabase
+
+        if native_available():
+            def cpu_ctx_t():
+                try:
+                    return jax.default_device(jax.devices("cpu")[0])
+                except Exception:
+                    return contextlib.nullcontext()
+            bigt = generate_flows(SynthConfig(n_series=2000,
+                                              points_per_series=30))
+
+            def trace_pass():
+                imt = IngestManager(FlowDatabase(ttl_seconds=12 * 3600))
+                enct = BlockEncoder(dicts=bigt.dicts)
+                payloads = [enct.encode(bigt) for _ in range(9)]
+                imt.ingest(payloads[0])   # warm dicts + jit
+                tt = time.perf_counter()
+                n = sum(imt.ingest(p)["rows"] for p in payloads[1:])
+                dtt = time.perf_counter() - tt
+                imt.close()
+                return n / dtt
+
+            saved_sample = os.environ.get("THEIA_TRACE_SAMPLE")
+            trates = {"off": 0.0, "sampled": 0.0}
+            try:
+                with cpu_ctx_t():
+                    # interleaved best-of-3 (the metrics-leg rationale:
+                    # host drift must not masquerade as overhead)
+                    for _ in range(3):
+                        os.environ["THEIA_TRACE_SAMPLE"] = "0"
+                        trates["off"] = max(trates["off"],
+                                            trace_pass())
+                        os.environ["THEIA_TRACE_SAMPLE"] = "1"
+                        trates["sampled"] = max(trates["sampled"],
+                                                trace_pass())
+            finally:
+                if saved_sample is None:
+                    os.environ.pop("THEIA_TRACE_SAMPLE", None)
+                else:
+                    os.environ["THEIA_TRACE_SAMPLE"] = saved_sample
+            if trates["off"] > 0:
+                tracing_overhead_pct = round(
+                    (trates["off"] - trates["sampled"])
+                    / trates["off"] * 100, 2)
+            print(f"ingest with sampled tracing: "
+                  f"{trates['sampled']:,.0f} rows/s "
+                  f"(tracing off: {trates['off']:,.0f}; overhead "
+                  f"{tracing_overhead_pct}%)", file=sys.stderr)
+    except Exception as e:
+        print(f"tracing-overhead bench skipped: {e}", file=sys.stderr)
 
     # WAL durability tax: e2e ingest throughput (the acceptance
     # surface — decode ∥ store+WAL ∥ detector, where spare cores can
@@ -1745,6 +1865,30 @@ def run_benchmarks() -> dict:
                     cluster_bench["distquery_bytes_shipped_per_group"] \
                         = round(got["bytesShipped"]
                                 / max(got["groupCount"], 1), 1)
+                    # tracing A/B on the distributed leg: the same
+                    # queries with THEIA_TRACE_SAMPLE=0 (no contexts
+                    # minted, no traceparent on the fan-out wire —
+                    # every in-process node flips at once); the
+                    # default-sampled loop above is the B side
+                    saved_ts = os.environ.get("THEIA_TRACE_SAMPLE")
+                    os.environ["THEIA_TRACE_SAMPLE"] = "0"
+                    try:
+                        t0n = time.perf_counter()
+                        for _ in range(n_q):
+                            _dq_query(dq_ports[1],
+                                      {**plan_doc, "cache": False})
+                        dt_n = time.perf_counter() - t0n
+                    finally:
+                        if saved_ts is None:
+                            os.environ.pop("THEIA_TRACE_SAMPLE",
+                                           None)
+                        else:
+                            os.environ["THEIA_TRACE_SAMPLE"] = \
+                                saved_ts
+                    if dt_n > 0:
+                        cluster_bench[
+                            "distquery_tracing_overhead_pct"] = round(
+                            (dt_q - dt_n) / dt_n * 100, 2)
                     # pruned leg: window covering ONLY the last
                     # node's placed range — every other peer prunes
                     win = {"start": bases[-1] - 1000,
@@ -1834,6 +1978,8 @@ def run_benchmarks() -> dict:
     }
     if metrics_overhead_pct is not None:
         result["ingest_metrics_overhead_pct"] = metrics_overhead_pct
+    if tracing_overhead_pct is not None:
+        result["ingest_tracing_overhead_pct"] = tracing_overhead_pct
     if wal_rates:
         result["wal_ingest_rows_per_sec"] = wal_rates
     if wal_store_rates:

@@ -334,7 +334,8 @@ def test_ingest_duplicate_retry_is_idempotent():
         assert out["rows"] == n and "duplicate" not in out
         before = len(db.flows)
         dup = im.ingest(payload1, stream="p", seq=1)  # byte-identical
-        assert dup == {"rows": n, "alerts": 0, "duplicate": True}
+        assert {k: dup[k] for k in ("rows", "alerts", "duplicate")} \
+            == {"rows": n, "alerts": 0, "duplicate": True}
         assert len(db.flows) == before                # nothing moved
         # the producer's NEXT block (new seq) is new work — rows
         # insert again, and the dedup retry above did not desync the
@@ -416,7 +417,8 @@ def test_retry_racing_completing_original_gets_duplicate(monkeypatch):
             return real_lookup(stream, seq)  # original recorded since
         monkeypatch.setattr(im.dedup, "lookup", racy_lookup)
         out = im.ingest(payload, stream="p", seq=1)
-        assert out == {"rows": n, "alerts": 0, "duplicate": True}
+        assert {k: out[k] for k in ("rows", "alerts", "duplicate")} \
+            == {"rows": n, "alerts": 0, "duplicate": True}
         assert len(calls) == 2               # the in-lock re-check ran
         assert len(db.flows) == 0            # nothing double-inserted
     finally:

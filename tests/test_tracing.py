@@ -1,0 +1,655 @@
+"""Cluster-wide distributed tracing + query EXPLAIN profiles +
+slow-query capture (PR 11).
+
+The cluster matrix runs IN-PROCESS with real HTTP between routing-mesh
+nodes (the test_distquery discipline): trace contexts must cross real
+sockets as `traceparent` headers, and `/debug/traces?trace=` must fan
+the lookup out over the real cluster transport. One caveat of the
+in-process mesh: the trace ring (and the node-id stamp) is
+process-global, so these tests assert trace-id propagation and
+span LINKAGE (remote parent span ids) — per-node attribution is
+exercised by the multi-process live verify (.claude/skills/verify).
+"""
+
+import json
+import socket
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from theia_tpu.data.synth import SynthConfig, generate_flows
+from theia_tpu.ingest import BlockEncoder
+from theia_tpu.ingest.client import IngestClient
+from theia_tpu.obs import metrics, trace
+from theia_tpu.query import QueryEngine, parse_plan
+from theia_tpu.query.explain import SLOW_QUERIES, SlowQueryLog
+from theia_tpu.store import FlowDatabase
+
+pytestmark = pytest.mark.obs
+
+TOKEN = "tracing-test-token"
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    metrics.enable()
+    metrics.REGISTRY.zero()
+    trace.reset()
+    SLOW_QUERIES.reset()
+    trace.set_node_id("")
+    yield
+    metrics.enable()
+    trace.set_node_id("")
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def wait_until(cond, timeout=20.0, interval=0.02, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def make_mesh(n, monkeypatch, token=None):
+    """n in-process role=peer managers on ephemeral ports."""
+    from theia_tpu.manager.api import TheiaManagerServer
+    monkeypatch.setenv("THEIA_RETENTION_INTERVAL", "0")
+    monkeypatch.setenv("THEIA_CLUSTER_HEARTBEAT", "0.05")
+    monkeypatch.setenv("THEIA_CLUSTER_BOUNDS_INTERVAL", "0.02")
+    ports = [free_port() for _ in range(n)]
+    peers = ",".join(
+        f"n{i}=http://127.0.0.1:{p}" for i, p in enumerate(ports))
+    dbs, servers = [], []
+    for i in range(n):
+        db = FlowDatabase()
+        dbs.append(db)
+        srv = TheiaManagerServer(db, port=ports[i],
+                                 cluster_peers=peers,
+                                 cluster_self=f"n{i}",
+                                 cluster_role="peer",
+                                 auth_token=token)
+        srv.start_background()
+        servers.append(srv)
+    return ports, dbs, servers
+
+
+def shutdown_all(servers):
+    for s in servers:
+        try:
+            s.shutdown()
+        except Exception:
+            pass
+
+
+def _get_json(port, path, token=None):
+    headers = {}
+    if token is not None:
+        headers["Authorization"] = f"Bearer {token}"
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}",
+                                 headers=headers)
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.load(r)
+
+
+def post_query(port, doc, timeout=30):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/query",
+        data=json.dumps(doc).encode(), method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.load(r)
+
+
+def wait_heartbeats(servers):
+    def _synced():
+        for srv in servers:
+            cmap = srv.cluster.cmap
+            for other in servers:
+                oid = other.cluster.cmap.self_id
+                if oid == cmap.self_id:
+                    continue
+                info = cmap.peer_info(oid).get("store") or {}
+                if info.get("fingerprint") != \
+                        other.queries.fingerprint_hash():
+                    return False
+        return True
+    wait_until(_synced, what="heartbeat store-state sync")
+
+
+# -- trace context primitives ----------------------------------------------
+
+def test_traceparent_round_trip_and_rejects_garbage():
+    ctx = trace.TraceContext(trace.new_trace_id(),
+                             trace.new_span_id(), True)
+    parsed = trace.parse_traceparent(trace.format_traceparent(ctx))
+    assert parsed.trace_id == ctx.trace_id
+    assert parsed.span_id == ctx.span_id
+    assert parsed.sampled is True
+    unsampled = trace.TraceContext(ctx.trace_id, ctx.span_id, False)
+    assert trace.parse_traceparent(
+        trace.format_traceparent(unsampled)).sampled is False
+    for bad in (None, "", "garbage", "00-short-short-01",
+                "00-" + "0" * 32 + "-" + "1" * 16 + "-01",
+                "00-" + "g" * 32 + "-" + "1" * 16 + "-01"):
+        assert trace.parse_traceparent(bad) is None
+
+
+def test_sampled_rate_deterministic(monkeypatch):
+    """The head-based decision is a pure function of (trace id, rate):
+    identical on every call — and therefore on every node."""
+    monkeypatch.setenv("THEIA_TRACE_SAMPLE", "0.5")
+    ids = [trace.new_trace_id() for _ in range(256)]
+    first = [trace.sampled_for(t) for t in ids]
+    assert first == [trace.sampled_for(t) for t in ids]
+    # a 0.5 rate keeps roughly half (256 coin flips: [64, 192] is
+    # > 6 sigma — deterministic ids, so no flake)
+    kept = sum(first)
+    assert 64 < kept < 192
+    monkeypatch.setenv("THEIA_TRACE_SAMPLE", "0")
+    assert not any(trace.sampled_for(t) for t in ids)
+    monkeypatch.setenv("THEIA_TRACE_SAMPLE", "1")
+    assert all(trace.sampled_for(t) for t in ids)
+
+
+def test_ingress_span_mints_and_adopts_context():
+    with trace.ingress_span("root.op") as sp:
+        ctx = trace.current_context()
+        assert ctx is not None and ctx.sampled
+        header = trace.traceparent()
+        assert header.startswith("00-" + ctx.trace_id)
+        with trace.span("inner.op"):
+            assert trace.current_context().trace_id == ctx.trace_id
+    spans = trace.recent(2)
+    assert [s["op"] for s in spans] == ["root.op", "inner.op"]
+    root, inner = spans[0], spans[1]
+    assert root["traceId"] == inner["traceId"]
+    assert inner["parentSpanId"] == root["spanId"]
+    assert "parentSpanId" not in root
+    # a second ingress ADOPTING the header continues the trace with a
+    # remote parent (the cross-node link)
+    with trace.ingress_span("remote.op", traceparent=header):
+        pass
+    remote = trace.recent(1)[0]
+    assert remote["traceId"] == root["traceId"]
+    assert remote["parentSpanId"] == root["spanId"]
+
+
+def test_sample_zero_records_nothing_and_stamps_nothing(monkeypatch):
+    monkeypatch.setenv("THEIA_TRACE_SAMPLE", "0")
+    with trace.ingress_span("quiet.op"):
+        assert trace.traceparent() is None
+        assert trace.current_context() is None
+        with trace.span("quiet.inner"):
+            pass
+    assert trace.recent(10) == []
+    # rate 0 is a LOCAL kill switch: even a peer's SAMPLED header is
+    # refused — nothing retained, nothing re-propagated
+    remote = trace.format_traceparent(trace.TraceContext(
+        trace.new_trace_id(), trace.new_span_id(), True))
+    with trace.ingress_span("quiet.remote", traceparent=remote):
+        assert trace.traceparent() is None
+    assert trace.recent(10) == []
+    # legacy spans OUTSIDE any ingress still flight-record
+    with trace.span("legacy.op"):
+        pass
+    assert trace.recent(1)[0]["op"] == "legacy.op"
+
+
+def test_ingest_sample_dial_is_independent(monkeypatch):
+    """THEIA_TRACE_SAMPLE_INGEST=0 silences the hot ingest ingress
+    without blinding other ingresses (query tracing stays on)."""
+    monkeypatch.setenv("THEIA_TRACE_SAMPLE_INGEST", "0")
+    from theia_tpu.manager.ingest import IngestManager
+    im = IngestManager(FlowDatabase(), n_shards=1)
+    try:
+        enc = BlockEncoder()
+        batch = generate_flows(SynthConfig(
+            n_series=8, points_per_series=5, anomaly_fraction=0.0,
+            seed=61), dicts=enc.dicts)
+        out = im.ingest(enc.encode(batch))
+        assert "traceId" not in out
+        assert not any(s["op"] == "ingest.request"
+                       for s in trace.recent(50))
+    finally:
+        im.close()
+    engine = QueryEngine(im.db)
+    doc = engine.execute(parse_plan({"aggregates": ["count"]}),
+                         use_cache=False)
+    assert doc.get("traceId")          # query ingress unaffected
+
+
+def test_child_span_carries_context_across_threads():
+    import threading
+    captured = {}
+
+    def worker(ctx):
+        with trace.child_span("pool.op", ctx, peer="x"):
+            captured["header"] = trace.traceparent()
+
+    with trace.ingress_span("fan.root"):
+        ctx = trace.current_context()
+        t = threading.Thread(target=worker, args=(ctx,))
+        t.start()
+        t.join()
+    assert ctx.trace_id in captured["header"]
+    ops = {s["op"]: s for s in trace.recent(10)}
+    assert ops["pool.op"]["traceId"] == ops["fan.root"]["traceId"]
+    assert ops["pool.op"]["parentSpanId"] == ops["fan.root"]["spanId"]
+
+
+# -- cross-node propagation over a real 3-node HTTP cluster ----------------
+
+def test_routed_ingest_yields_one_stitched_trace(monkeypatch):
+    """One producer batch through the router spreads rows to owner
+    nodes over real HTTP; every hop's spans must share the producer
+    request's trace id, and the stitched /debug/traces?trace= view —
+    queried from ANY node — must contain exactly one root."""
+    ports, dbs, servers = make_mesh(3, monkeypatch)
+    try:
+        enc = BlockEncoder()
+        batch = generate_flows(SynthConfig(
+            n_series=48, points_per_series=6, anomaly_fraction=0.0,
+            seed=7), dicts=enc.dicts)
+        client = IngestClient(f"http://127.0.0.1:{ports[0]}",
+                              stream="traced")
+        out = client.send(enc.encode(batch))
+        assert min(len(db.flows) for db in dbs) > 0   # truly routed
+        trace_id = out.get("traceId")
+        assert trace_id and len(trace_id) == 32
+        for port in ports:       # any node answers the stitched view
+            doc = _get_json(port, f"/debug/traces?trace={trace_id}")
+            spans = doc["spans"]
+            assert spans and all(
+                s["traceId"] == trace_id for s in spans)
+            ingests = [s for s in spans
+                       if s["op"] == "ingest.request"]
+            # origin + one per remote owner that received a slice
+            assert len(ingests) >= 2
+            by_id = {s["spanId"] for s in spans}
+            roots = [s for s in spans
+                     if s.get("parentSpanId") not in by_id]
+            assert len(roots) == 1           # ONE stitched tree
+            assert roots[0]["op"] == "ingest.request"
+            forwards = [s for s in spans
+                        if s["op"] == "router.forward"]
+            assert forwards                  # the hop spans exist
+            # every forwarded ingest hangs off a router.forward
+            fwd_ids = {s["spanId"] for s in forwards}
+            remote_ingests = [s for s in ingests
+                              if s is not roots[0]]
+            assert all(s["parentSpanId"] in fwd_ids
+                       for s in remote_ingests)
+    finally:
+        shutdown_all(servers)
+
+
+def test_distributed_query_yields_one_stitched_trace(monkeypatch):
+    ports, dbs, servers = make_mesh(3, monkeypatch)
+    try:
+        enc = BlockEncoder()
+        client = IngestClient(f"http://127.0.0.1:{ports[0]}",
+                              stream="qtrace")
+        batch = generate_flows(SynthConfig(
+            n_series=48, points_per_series=6, anomaly_fraction=0.0,
+            seed=8), dicts=enc.dicts)
+        client.send(enc.encode(batch))
+        wait_heartbeats(servers)
+        trace.reset()            # isolate the query's trace
+        got = post_query(ports[1], {"groupBy": "destinationIP",
+                                    "aggregates": ["count"],
+                                    "cache": False})
+        assert got["partial"] is False
+        trace_id = got.get("traceId")
+        assert trace_id
+        doc = _get_json(ports[2], f"/debug/traces?trace={trace_id}")
+        spans = doc["spans"]
+        ops = [s["op"] for s in spans]
+        assert ops.count("query.request") == 1      # ONE coordinator
+        assert ops.count("query.partial") == 2      # both peers served
+        by_id = {s["spanId"] for s in spans}
+        roots = [s for s in spans
+                 if s.get("parentSpanId") not in by_id]
+        assert len(roots) == 1 and roots[0]["op"] == "query.request"
+        fanouts = {s["spanId"] for s in spans
+                   if s["op"] == "query.fanout"}
+        partials = [s for s in spans if s["op"] == "query.partial"]
+        assert all(s["parentSpanId"] in fanouts for s in partials)
+    finally:
+        shutdown_all(servers)
+
+
+def test_trace_ring_zero_retains_nothing_cluster_wide(monkeypatch):
+    """THEIA_TRACE_RING=0 keeps the promise under tracing: requests
+    still ack (with a trace id — the context exists, propagation
+    works), but no node retains a single span."""
+    import collections
+    monkeypatch.setattr(trace, "_ring", collections.deque(maxlen=0))
+    ports, dbs, servers = make_mesh(2, monkeypatch)
+    try:
+        enc = BlockEncoder()
+        out = IngestClient(f"http://127.0.0.1:{ports[0]}",
+                           stream="noring").send(
+            enc.encode(generate_flows(SynthConfig(
+                n_series=24, points_per_series=5,
+                anomaly_fraction=0.0, seed=9), dicts=enc.dicts)))
+        trace_id = out.get("traceId")
+        assert trace_id
+        for port in ports:
+            doc = _get_json(port, f"/debug/traces?trace={trace_id}")
+            assert doc["spans"] == []
+    finally:
+        shutdown_all(servers)
+
+
+def test_debug_traces_trace_param_token_gated(monkeypatch):
+    ports, dbs, servers = make_mesh(2, monkeypatch, token=TOKEN)
+    try:
+        def code_of(path, token=None):
+            try:
+                _get_json(ports[0], path, token=token)
+                return 200
+            except urllib.error.HTTPError as e:
+                return e.code
+        assert code_of("/debug/traces?trace=" + "a" * 32) == 401
+        assert code_of("/debug/traces?trace=" + "a" * 32,
+                       token="wrong") == 403
+        assert code_of("/debug/traces?trace=" + "a" * 32,
+                       token=TOKEN) == 200
+        assert code_of("/debug/slow_queries") == 401
+        assert code_of("/debug/slow_queries", token=TOKEN) == 200
+    finally:
+        shutdown_all(servers)
+
+
+# -- EXPLAIN profiles ------------------------------------------------------
+
+def _parts_db(monkeypatch, rows_seed=3):
+    monkeypatch.setenv("THEIA_STORE_ENGINE", "parts")
+    monkeypatch.setenv("THEIA_STORE_MEMTABLE_ROWS", "256")
+    db = FlowDatabase()
+    enc = BlockEncoder()
+    for seed in range(rows_seed):
+        db.insert_flows(generate_flows(SynthConfig(
+            n_series=40, points_per_series=10, anomaly_fraction=0.0,
+            seed=seed + 1), dicts=enc.dicts))
+    return db
+
+
+def test_explain_rows_bit_identical_on_randomized_plans(monkeypatch):
+    """explain=1 must be pure observation: for a randomized pile of
+    plans over the parts engine, result rows/groups are bit-identical
+    with and without the profile, and the profile's scan totals agree
+    with the result doc's."""
+    db = _parts_db(monkeypatch)
+    engine = QueryEngine(db)
+    rng = np.random.default_rng(11)
+    group_pool = ["destinationIP", "sourceIP",
+                  "destinationTransportPort", "protocolIdentifier"]
+    agg_pool = ["count", "sum:octetDeltaCount", "mean:throughput",
+                "min:flowEndSeconds", "max:octetDeltaCount"]
+    for trial in range(12):
+        doc = {
+            "groupBy": ",".join(
+                rng.choice(group_pool,
+                           size=int(rng.integers(0, 3)),
+                           replace=False).tolist()),
+            "aggregates": rng.choice(
+                agg_pool, size=int(rng.integers(1, 4)),
+                replace=False).tolist(),
+            "k": int(rng.integers(0, 50)),
+        }
+        if rng.random() < 0.5:
+            doc["filters"] = [{"column": "destinationTransportPort",
+                               "op": ">=",
+                               "value": int(rng.integers(0, 500))}]
+        if rng.random() < 0.5:
+            lo = int(rng.integers(0, 2 ** 31))
+            doc["start"], doc["end"] = lo, lo + int(
+                rng.integers(1, 2 ** 31))
+        plan = parse_plan(doc)
+        plain = engine.execute(plan, use_cache=False)
+        explained = engine.execute(plan, use_cache=False,
+                                   explain=True)
+        assert explained["rows"] == plain["rows"], doc
+        assert explained["groupCount"] == plain["groupCount"]
+        prof = explained["profile"]
+        assert prof["rowsScanned"] == explained["rowsScanned"]
+        assert prof["partsScanned"] == explained["partsScanned"]
+        assert prof["partsPruned"] == explained["partsPruned"]
+        listed = prof.get("parts") or []
+        if listed and not prof.get("partsListTruncated"):
+            assert sum(1 for p in listed if p.get("scanned")) == \
+                prof["partsScanned"]
+            assert sum(1 for p in listed if p.get("pruned")) == \
+                prof["partsPruned"]
+
+
+def test_explain_prune_reasons(monkeypatch):
+    """Each pruned part names WHY: time window, numeric range, or a
+    dictionary-code miss."""
+    db = _parts_db(monkeypatch)
+    engine = QueryEngine(db)
+    # windowed: everything lives far below this window
+    plan = parse_plan({"aggregates": ["count"],
+                       "start": 2 ** 40, "end": 2 ** 41})
+    prof = engine.execute(plan, use_cache=False,
+                          explain=True)["profile"]
+    reasons = {p["pruned"] for p in prof.get("parts", [])
+               if p.get("pruned")}
+    assert reasons == {"time_window"}
+    # numeric range that no row reaches (part min/max today covers
+    # the time columns — ROADMAP item 2 extends it to all numerics)
+    plan = parse_plan({"aggregates": ["count"],
+                       "filters": [{"column": "flowEndSeconds",
+                                    "op": ">=", "value": 2 ** 60}]})
+    prof = engine.execute(plan, use_cache=False,
+                          explain=True)["profile"]
+    reasons = {p["pruned"] for p in prof.get("parts", [])
+               if p.get("pruned")}
+    assert reasons == {"range:flowEndSeconds"}
+    assert prof["rowsMatched"] == 0
+    # dictionary-code miss: an IP no dictionary ever minted
+    plan = parse_plan({"aggregates": ["count"],
+                       "filters": [{"column": "destinationIP",
+                                    "op": "eq",
+                                    "value": "255.255.255.255"}]})
+    prof = engine.execute(plan, use_cache=False,
+                          explain=True)["profile"]
+    reasons = {p["pruned"] for p in prof.get("parts", [])
+               if p.get("pruned")}
+    assert reasons == {"codes:destinationIP"}
+
+
+def test_explain_cache_hit_profile(monkeypatch):
+    db = _parts_db(monkeypatch, rows_seed=1)
+    engine = QueryEngine(db)
+    plan = parse_plan({"groupBy": "destinationIP",
+                       "aggregates": ["count"]})
+    miss = engine.execute(plan, explain=True)
+    assert miss["cache"] == "miss"
+    assert miss["profile"]["cache"] == "miss"
+    hit = engine.execute(plan, explain=True)
+    assert hit["cache"] == "hit"
+    assert hit["profile"]["cache"] == "hit"
+    assert hit["profile"]["fingerprint"] == \
+        miss["profile"]["fingerprint"]
+    assert hit["rows"] == miss["rows"]
+
+
+def test_explain_over_http_and_distributed(monkeypatch):
+    ports, dbs, servers = make_mesh(2, monkeypatch)
+    try:
+        enc = BlockEncoder()
+        IngestClient(f"http://127.0.0.1:{ports[0]}",
+                     stream="exp").send(enc.encode(generate_flows(
+                         SynthConfig(n_series=32, points_per_series=6,
+                                     anomaly_fraction=0.0, seed=21),
+                         dicts=enc.dicts)))
+        wait_heartbeats(servers)
+        doc = {"groupBy": "destinationIP", "aggregates": ["count"],
+               "cache": False}
+        plain = post_query(ports[0], doc)
+        explained = post_query(ports[0], {**doc, "explain": True})
+        assert explained["rows"] == plain["rows"]
+        assert "profile" not in plain
+        prof = explained["profile"]
+        assert prof["engine"] == "cluster"
+        peer_entries = {p["peer"]: p for p in prof["peers"]}
+        assert peer_entries["n1"]["status"] == "queried"
+        assert peer_entries["n1"]["bytes"] > 0
+        assert "merge" in prof["phases"]
+        # GET explain=1 works too
+        got = _get_json(
+            ports[0],
+            "/query?group_by=destinationIP&agg=count&cache=0"
+            "&explain=1")
+        assert got["rows"] == plain["rows"]
+        assert got["profile"]["engine"] == "cluster"
+    finally:
+        shutdown_all(servers)
+
+
+# -- slow-query capture ----------------------------------------------------
+
+def test_slow_query_capture_ring_bound(monkeypatch):
+    monkeypatch.setenv("THEIA_QUERY_SLOW_MS", "0.000001")
+    db = _parts_db(monkeypatch, rows_seed=1)
+    engine = QueryEngine(db)
+    log = SlowQueryLog(capacity=4)
+    monkeypatch.setattr("theia_tpu.query.engine.SLOW_QUERIES", log)
+    plan = parse_plan({"groupBy": "destinationIP",
+                       "aggregates": ["count"]})
+    for _ in range(9):
+        engine.execute(plan, use_cache=False)
+    entries = log.snapshot()
+    assert len(entries) == 4                 # bounded
+    assert log.captured == 9
+    entry = entries[0]
+    assert entry["plan"]["groupBy"] == ["destinationIP"]
+    assert entry["profile"]["engine"] == "parts"
+    assert entry["tookMs"] >= 0
+    # the capture links back to its distributed trace
+    assert len(entry["traceId"]) == 32
+    # cache hits are not executions — no capture
+    log.reset()
+    engine.execute(plan)                     # miss (captured)
+    engine.execute(plan)                     # hit
+    assert log.captured == 1
+
+
+def test_slow_query_disabled_by_env(monkeypatch):
+    monkeypatch.setenv("THEIA_QUERY_SLOW_MS", "0")
+    db = _parts_db(monkeypatch, rows_seed=1)
+    engine = QueryEngine(db)
+    log = SlowQueryLog(capacity=4)
+    monkeypatch.setattr("theia_tpu.query.engine.SLOW_QUERIES", log)
+    engine.execute(parse_plan({"aggregates": ["count"]}),
+                   use_cache=False)
+    assert log.captured == 0
+
+
+def test_slow_queries_endpoint(monkeypatch):
+    monkeypatch.setenv("THEIA_QUERY_SLOW_MS", "0.000001")
+    from theia_tpu.manager.api import TheiaManagerServer
+    SLOW_QUERIES.reset()
+    db = FlowDatabase()
+    enc = BlockEncoder()
+    db.insert_flows(generate_flows(SynthConfig(
+        n_series=16, points_per_series=5, anomaly_fraction=0.0,
+        seed=31), dicts=enc.dicts))
+    srv = TheiaManagerServer(db, port=0)
+    srv.start_background()
+    try:
+        post_query(srv.port, {"groupBy": "destinationIP",
+                              "aggregates": ["count"],
+                              "cache": False})
+        doc = _get_json(srv.port, "/debug/slow_queries")
+        assert doc["thresholdMs"] == pytest.approx(0.000001)
+        assert doc["captured"] >= 1
+        assert doc["queries"][0]["profile"]["engine"] == "flat"
+    finally:
+        srv.shutdown()
+        SLOW_QUERIES.reset()
+
+
+# -- heartbeat RTT + cluster top -------------------------------------------
+
+def test_heartbeat_rtt_recorded_and_surfaced(monkeypatch):
+    ports, dbs, servers = make_mesh(2, monkeypatch)
+    try:
+        wait_until(lambda: servers[0].cluster.heartbeat.last_rtt,
+                   what="first heartbeat rtt")
+        health = _get_json(ports[0], "/healthz")
+        rtts = health["cluster"]["heartbeatRttSeconds"]
+        assert "n1" in rtts and rtts["n1"] > 0
+        h = metrics.REGISTRY.get("theia_cluster_heartbeat_rtt_seconds")
+        assert h.labels(peer="n1").count() >= 1
+    finally:
+        shutdown_all(servers)
+
+
+def test_top_cluster_renders_per_node_columns(monkeypatch, capsys):
+    from theia_tpu.cli.__main__ import main as cli_main
+    ports, dbs, servers = make_mesh(2, monkeypatch)
+    try:
+        enc = BlockEncoder()
+        IngestClient(f"http://127.0.0.1:{ports[0]}",
+                     stream="topc").send(enc.encode(generate_flows(
+                         SynthConfig(n_series=16, points_per_series=5,
+                                     anomaly_fraction=0.0, seed=41),
+                         dicts=enc.dicts)))
+        addr_list = ",".join(f"http://127.0.0.1:{p}" for p in ports)
+        cli_main(["--manager-addr", addr_list, "top", "--cluster",
+                  "-n", "2", "-i", "0.05", "--no-clear"])
+        out = capsys.readouterr().out
+        assert "theia top --cluster — 2/2 nodes up" in out
+        assert "TOTAL" in out
+        for p in ports:
+            assert f"127.0.0.1:{p}" in out
+        # a dead endpoint renders DOWN instead of crashing the loop
+        dead = free_port()
+        cli_main(["--manager-addr",
+                  f"http://127.0.0.1:{ports[0]},"
+                  f"http://127.0.0.1:{dead}",
+                  "top", "--cluster", "-n", "1", "-i", "0.05",
+                  "--no-clear"])
+        out = capsys.readouterr().out
+        assert "1/2 nodes up" in out
+        assert "DOWN" in out
+    finally:
+        shutdown_all(servers)
+
+
+def test_theia_trace_cli_renders_tree(monkeypatch, capsys):
+    from theia_tpu.cli.__main__ import main as cli_main
+    ports, dbs, servers = make_mesh(2, monkeypatch)
+    try:
+        enc = BlockEncoder()
+        out = IngestClient(f"http://127.0.0.1:{ports[0]}",
+                           stream="clitrace").send(
+            enc.encode(generate_flows(SynthConfig(
+                n_series=32, points_per_series=5,
+                anomaly_fraction=0.0, seed=51), dicts=enc.dicts)))
+        trace_id = out["traceId"]
+        cli_main(["--manager-addr", f"http://127.0.0.1:{ports[0]}",
+                  "trace", trace_id])
+        text = capsys.readouterr().out
+        assert f"trace {trace_id}" in text
+        assert "ingest.request" in text
+        # unknown trace id: a clear message, not a crash
+        cli_main(["--manager-addr", f"http://127.0.0.1:{ports[0]}",
+                  "trace", "f" * 32])
+        assert "no spans retained" in capsys.readouterr().out
+    finally:
+        shutdown_all(servers)

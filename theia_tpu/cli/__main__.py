@@ -28,6 +28,7 @@ import ssl
 import sys
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 import uuid
 from typing import Dict, Optional
@@ -705,6 +706,8 @@ def query_cmd(args) -> None:
         doc["timeColumn"] = args.time_column
     if args.order_by:
         doc["orderBy"] = args.order_by
+    if args.explain:
+        doc["explain"] = True
     from ..ingest.client import IngestClient, IngestError
     addrs = [a.strip() for a in args.manager_addr.split(",")
              if a.strip()]
@@ -735,12 +738,56 @@ def query_cmd(args) -> None:
         footer += (f"; cluster {peers.get('queried', 0)} peers "
                    f"queried / {peers.get('pruned', 0)} pruned, "
                    f"{out.get('bytesShipped', 0):,} partial bytes")
+    if out.get("traceId"):
+        footer += f"; trace {out['traceId']}"
     print(footer)
+    if args.explain and out.get("profile"):
+        _print_explain(out["profile"])
     if out.get("partial"):
         print(f"!! PARTIAL result — peers unavailable: "
               f"{', '.join(out.get('missingPeers', []))} "
               f"(answer covers the reachable nodes only)",
               file=sys.stderr)
+
+
+def _print_explain(prof: Dict) -> None:
+    """Render the EXPLAIN profile: header facts, phase timings, then
+    per-peer (coordinator) and per-part (local engine) tables."""
+    head = [f"engine {prof.get('engine')}"]
+    if prof.get("kernel"):
+        head.append(f"kernel {prof['kernel']}")
+    head.append(f"cache {prof.get('cache', '?')}")
+    if prof.get("fingerprint"):
+        head.append(f"fingerprint {prof['fingerprint']}")
+    if prof.get("rowsMatched") is not None:
+        head.append(f"{prof.get('rowsScanned', 0):,} rows scanned / "
+                    f"{prof['rowsMatched']:,} matched")
+    elif prof.get("rowsMatchedLocal") is not None:
+        head.append(f"{prof.get('rowsScanned', 0):,} rows scanned "
+                    f"cluster-wide / {prof['rowsMatchedLocal']:,} "
+                    f"matched locally")
+    print("EXPLAIN: " + ", ".join(head))
+    phases = prof.get("phases") or {}
+    if phases:
+        print("  phases: " + ", ".join(
+            f"{k} {v} ms" for k, v in phases.items()))
+    peers = prof.get("peers") or []
+    if peers:
+        print("  peers:")
+        _print_table(peers, ["peer", "status", "tookMs", "execMs",
+                             "bytes", "rowsScanned", "partsScanned",
+                             "partsPruned", "reason"])
+    parts = prof.get("parts") or []
+    if parts:
+        print(f"  parts ({len(parts)}"
+              + (f" shown, {prof['partsListTruncated']} more"
+                 if prof.get("partsListTruncated") else "")
+              + "):")
+        shown = [{**p, "fate": (p.get("pruned") or "scanned")}
+                 for p in parts]
+        _print_table(shown, ["part", "tier", "rows", "fate"])
+    if prof.get("memtableRows"):
+        print(f"  memtable: {prof['memtableRows']:,} rows scanned")
 
 
 # -- top (live rates from GET /metrics; no reference equivalent — the
@@ -775,9 +822,218 @@ def _top_rows(sample, prev, dt):
     return rows
 
 
+def trace_cmd(args) -> None:
+    """Fetch one distributed trace by id (from ANY cluster node — the
+    queried node fans the lookup out to its live peers and stitches
+    the spans) and render the cross-node tree."""
+    doc = _request(
+        args.manager_addr, "GET",
+        "/debug/traces?trace="
+        + urllib.parse.quote(args.trace_id, safe=""))
+    spans = doc.get("spans") or []
+    if not spans:
+        print(f"trace {args.trace_id}: no spans retained "
+              f"(expired from the ring, unsampled, or "
+              f"THEIA_TRACE_RING=0)")
+        return
+    nodes = doc.get("nodes") or []
+    print(f"trace {doc.get('trace')} — {len(spans)} spans across "
+          f"{len(nodes)} node(s): {', '.join(nodes)}")
+    if doc.get("peersMissing"):
+        print(f"!! peers unreachable (trace may be incomplete): "
+              f"{', '.join(doc['peersMissing'])}", file=sys.stderr)
+    if doc.get("clockNote"):
+        print(f"   note: {doc['clockNote']}")
+    by_id = {s.get("spanId"): s for s in spans if s.get("spanId")}
+    children: Dict[str, list] = {}
+    roots = []
+    for s in spans:
+        parent = s.get("parentSpanId")
+        if parent and parent in by_id:
+            children.setdefault(parent, []).append(s)
+        else:
+            roots.append(s)
+    t0 = min(float(s.get("startTime") or 0) for s in spans)
+    meta_keys = ("op", "startTime", "durationMs", "parent", "thread",
+                 "traceId", "spanId", "parentSpanId", "node", "error")
+
+    def render(s, depth):
+        offset = (float(s.get("startTime") or 0) - t0) * 1000
+        attrs = " ".join(f"{k}={v}" for k, v in s.items()
+                         if k not in meta_keys)
+        line = (f"{'  ' * depth}{'└ ' if depth else ''}{s['op']} "
+                f"[{s.get('node') or 'local'}] "
+                f"{s.get('durationMs', 0)} ms @+{offset:,.1f} ms")
+        if s.get("error"):
+            line += f" ERROR={s['error']}"
+        if attrs:
+            line += f"  {attrs}"
+        print(line)
+        kids = sorted(children.get(s.get("spanId"), []),
+                      key=lambda c: float(c.get("startTime") or 0))
+        for c in kids:
+            render(c, depth + 1)
+
+    for root in sorted(roots,
+                       key=lambda s: float(s.get("startTime") or 0)):
+        render(root, 0)
+
+
+# -- cluster-wide top ----------------------------------------------------
+
+def _cluster_top_sample(clients):
+    """One scrape pass: addr → parsed exposition (None when the node
+    is unreachable after the client's retry budget). Scrapes run
+    CONCURRENTLY — one hung node costs one timeout, not its place in
+    a serial chain, exactly when a degraded cluster is what the
+    operator is trying to see."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from ..obs import prom as _prom
+
+    def scrape(client):
+        try:
+            return _prom.parse(client.request_text("GET", "/metrics"))
+        except Exception:   # IngestError, parse failure: node is down
+            return None
+
+    with ThreadPoolExecutor(max_workers=max(2, len(clients))) as pool:
+        futs = [(addr, pool.submit(scrape, client))
+                for addr, client in clients]
+        return {addr: fut.result() for addr, fut in futs}
+
+
+def _node_label(addr) -> str:
+    """host:port — unambiguous even when peer ids are unknown (a node
+    scrapes fine before its cluster tier is configured)."""
+    return addr.split("://", 1)[-1]
+
+
+#: rung names mirror manager/admission.py LEVEL_NAMES (kept literal
+#: here so `theia top` stays import-light)
+_ADMISSION_NAMES = ("ok", "sampled", "shed_detector", "reject")
+
+
+def _cluster_top_rows(samples, prev, dt):
+    """Per-node columns + a cluster-total row. Counters render as
+    rates against the previous scrape of the SAME node."""
+    def rate(sample, prior, name):
+        if sample is None or prior is None or dt <= 0:
+            return 0.0
+        cur = sum(v for (n, _), v in sample.items() if n == name)
+        old = sum(v for (n, _), v in prior.items() if n == name)
+        return max(cur - old, 0.0) / dt
+
+    def gauge(sample, name, default=0.0):
+        if sample is None:
+            return default
+        return sum(v for (n, _), v in sample.items() if n == name)
+
+    rows = []
+    totals = {"rows": 0.0, "parts": 0.0, "q": 0.0}
+    for addr, sample in samples.items():
+        prior = (prev or {}).get(addr)
+        if sample is None:
+            rows.append({"NODE": _node_label(addr),
+                         "STATUS": "DOWN", "ROWS/s": "", "REPL LAG": "",
+                         "ADMISSION": "", "PARTS": "", "QUERY/s": ""})
+            continue
+        rows_s = rate(sample, prior, "theia_ingest_rows_total")
+        q_s = (rate(sample, prior, "theia_query_cache_hits_total")
+               + rate(sample, prior, "theia_query_seconds_count")
+               + rate(sample, prior, "theia_query_fanout_seconds_count"))
+        lags = [v for (n, _), v in sample.items()
+                if n == "theia_repl_lag_records"]
+        lvl = int(gauge(sample, "theia_admission_level"))
+        parts = gauge(sample, "theia_store_parts")
+        totals["rows"] += rows_s
+        totals["parts"] += parts
+        totals["q"] += q_s
+        rows.append({
+            "NODE": _node_label(addr),
+            "STATUS": "up",
+            "ROWS/s": f"{rows_s:,.0f}",
+            "REPL LAG": f"{max(lags):,.0f}" if lags else "-",
+            "ADMISSION": _ADMISSION_NAMES[
+                min(max(lvl, 0), len(_ADMISSION_NAMES) - 1)],
+            "PARTS": f"{parts:,.0f}",
+            "QUERY/s": f"{q_s:,.1f}",
+        })
+    rows.append({
+        "NODE": "TOTAL", "STATUS": "",
+        "ROWS/s": f"{totals['rows']:,.0f}", "REPL LAG": "",
+        "ADMISSION": "", "PARTS": f"{totals['parts']:,.0f}",
+        "QUERY/s": f"{totals['q']:,.1f}",
+    })
+    return rows
+
+
+def top_cluster(args) -> None:
+    """`theia top --cluster`: scrape every endpoint in the (comma-
+    separated) --manager-addr list and render per-node columns plus a
+    cluster-total row. Each endpoint rides its own IngestClient, so a
+    flapping node retries/backs off exactly like a producer would."""
+    from ..ingest.client import IngestClient
+    addrs = [a.strip() for a in args.manager_addr.split(",")
+             if a.strip()]
+    clients = [(a, IngestClient(a, stream="cli-top", token=_TOKEN,
+                                ca_cert=_CA_CERT or None,
+                                timeout=5.0,
+                                max_attempts=2, backoff_base=0.1,
+                                backoff_cap=0.5))
+               for a in addrs]
+    prev = None
+    prev_t = 0.0
+    i = 0
+    try:
+        while True:
+            samples = _cluster_top_sample(clients)
+            now = time.time()
+            dt = now - prev_t if prev is not None else 0.0
+            if not args.no_clear and sys.stdout.isatty():
+                print("\x1b[2J\x1b[H", end="")
+            stamp = datetime.datetime.fromtimestamp(now).strftime(
+                TIME_FORMAT)
+            n_up = sum(1 for s in samples.values() if s is not None)
+            print(f"theia top --cluster — {n_up}/{len(addrs)} nodes "
+                  f"up  {stamp}")
+            # per-peer heartbeat RTT averages from any live node's
+            # histogram (scrape-cumulative: sum/count)
+            rtts = []
+            for sample in samples.values():
+                if sample is None:
+                    continue
+                for (name, labels), v in sample.items():
+                    if name == "theia_cluster_heartbeat_rtt_seconds_sum" \
+                            and labels:
+                        peer = dict(labels).get("peer")
+                        cnt = sample.get(
+                            ("theia_cluster_heartbeat_rtt_seconds_count",
+                             labels), 0.0)
+                        if cnt:
+                            rtts.append((peer, v / cnt * 1e3))
+                break   # one node's view is the cluster's link set
+            if rtts:
+                print("heartbeat rtt: " + ", ".join(
+                    f"{p} {ms:.1f}ms" for p, ms in sorted(rtts)))
+            _print_table(_cluster_top_rows(samples, prev, dt),
+                         ["NODE", "STATUS", "ROWS/s", "REPL LAG",
+                          "ADMISSION", "PARTS", "QUERY/s"])
+            prev, prev_t = samples, now
+            i += 1
+            if args.iterations and i >= args.iterations:
+                return
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+
+
 def top(args) -> None:
     """Poll GET /metrics and render a live rates table (rates are
     deltas between successive scrapes)."""
+    if getattr(args, "cluster", False):
+        top_cluster(args)
+        return
     from ..obs import prom as _prom
     prev = None
     prev_t = 0.0
@@ -811,9 +1067,7 @@ def top(args) -> None:
                   f"({len(rows)} series)")
             lvl = sample.get(("theia_admission_level", ()))
             if lvl is not None:
-                # rung names mirror manager/admission.py LEVEL_NAMES
-                # (kept literal here so `theia top` stays import-light)
-                names = ("ok", "sampled", "shed_detector", "reject")
+                names = _ADMISSION_NAMES
                 i_lvl = min(max(int(lvl), 0), len(names) - 1)
                 pressure = sample.get(("theia_admission_pressure",
                                        ()), 0.0)
@@ -832,6 +1086,14 @@ def top(args) -> None:
                     cell = f"{peer} {'up' if up else 'DOWN'}"
                     if lag is not None:
                         cell += f" lag {lag:,.0f}"
+                    rtt_sum = sample.get(
+                        ("theia_cluster_heartbeat_rtt_seconds_sum",
+                         (("peer", peer),)))
+                    rtt_n = sample.get(
+                        ("theia_cluster_heartbeat_rtt_seconds_count",
+                         (("peer", peer),)), 0.0)
+                    if rtt_sum is not None and rtt_n:
+                        cell += f" rtt {rtt_sum / rtt_n * 1e3:.1f}ms"
                     return cell
                 n_up = sum(1 for _, up in peer_rows if up)
                 print(f"cluster: {n_up}/{len(peer_rows)} peers up — "
@@ -880,11 +1142,17 @@ def top(args) -> None:
                 dq = dh + _qdelta("theia_query_seconds_count")
                 hit_pct = (100.0 * dh / (dh + dm_q)
                            if (dh + dm_q) > 0 else 0.0)
-                print(f"query engine: "
-                      f"{dq / dt_q if dt_q > 0 else 0.0:,.1f} q/s, "
-                      f"{dscan / dt_q if dt_q > 0 else 0.0:,.0f} "
-                      f"rows/s scanned, "
-                      f"cache hit {hit_pct:.0f}%")
+                qline = (f"query engine: "
+                         f"{dq / dt_q if dt_q > 0 else 0.0:,.1f} q/s, "
+                         f"{dscan / dt_q if dt_q > 0 else 0.0:,.0f} "
+                         f"rows/s scanned, "
+                         f"cache hit {hit_pct:.0f}%")
+                slow = sample.get(
+                    ("theia_query_slow_queries_total", ()), 0.0)
+                if slow:
+                    # captured profiles live at /debug/slow_queries
+                    qline += f", {slow:,.0f} slow captured"
+                print(qline)
                 # distributed fan-out header (routing-mesh nodes):
                 # cumulative peers queried/pruned/failed — nonzero
                 # only where the coordinator actually runs
@@ -1180,6 +1448,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "first aggregate)")
     q.add_argument("--json", action="store_true",
                    help="print the raw result document")
+    q.add_argument("--explain", action="store_true",
+                   help="attach the execution profile (per-part "
+                        "scanned/pruned with reasons, kernel, cache, "
+                        "per-peer fan-out timings) — the result rows "
+                        "are identical either way")
     q.set_defaults(fn=query_cmd)
 
     sb = sub.add_parser("supportbundle")
@@ -1202,7 +1475,23 @@ def build_parser() -> argparse.ArgumentParser:
                     help="render N tables then exit (0 = forever)")
     tp.add_argument("--no-clear", dest="no_clear", action="store_true",
                     help="append tables instead of clearing the screen")
+    tp.add_argument("--cluster", action="store_true",
+                    help="scrape EVERY endpoint in the (comma-"
+                         "separated) --manager-addr list and render "
+                         "per-node columns (rows/s, repl lag, "
+                         "admission rung, parts, query/s) plus a "
+                         "cluster-total row")
     tp.set_defaults(fn=top)
+
+    tr = sub.add_parser("trace",
+                        help="fetch one distributed trace by id from "
+                             "any cluster node (the node stitches "
+                             "every peer's spans) and render the "
+                             "cross-node tree")
+    tr.add_argument("trace_id", help="the traceId from an ingest ack, "
+                                     "a /query result, or a span in "
+                                     "/debug/traces")
+    tr.set_defaults(fn=trace_cmd)
 
     ver = sub.add_parser("version")
     ver.set_defaults(fn=version)

@@ -49,6 +49,11 @@ _M_PEER_UP = _metrics.gauge(
 _M_HEARTBEATS = _metrics.counter(
     "theia_cluster_heartbeats_total",
     "Heartbeat probes sent, by outcome", labelnames=("result",))
+_M_HEARTBEAT_RTT = _metrics.histogram(
+    "theia_cluster_heartbeat_rtt_seconds",
+    "Round-trip time of successful heartbeat probes, per peer — the "
+    "cluster's live link-latency read (`theia top` renders the "
+    "per-peer average in its cluster header)", labelnames=("peer",))
 
 
 class ClusterConfigError(ValueError):
@@ -217,6 +222,9 @@ class HeartbeatLoop:
                          if interval is None else float(interval))
         self.on_seen = on_seen
         self.beats = 0
+        #: peer -> last successful probe RTT in seconds (served under
+        #: /healthz `cluster.heartbeatRttSeconds`)
+        self.last_rtt: Dict[str, float] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -242,13 +250,17 @@ class HeartbeatLoop:
         """Probe every other peer once; returns the ids that answered."""
         alive: List[str] = []
         for peer in self.cmap.others():
+            t0 = time.perf_counter()
             try:
                 info = self.probe(peer)
             except Exception as e:
                 _M_HEARTBEATS.labels(result="failed").inc()
                 self.cmap.mark_failed(peer, f"{type(e).__name__}: {e}")
             else:
+                rtt = time.perf_counter() - t0
                 _M_HEARTBEATS.labels(result="ok").inc()
+                _M_HEARTBEAT_RTT.labels(peer=peer).observe(rtt)
+                self.last_rtt[peer] = rtt
                 self.cmap.mark_alive(peer, info)
                 if self.on_seen is not None:
                     self.on_seen(peer, info)

@@ -476,6 +476,10 @@ class ClusterNode:
             "term": self.current_term(),
             "peers": self.cmap.snapshot(),
         }
+        rtts = dict(self.heartbeat.last_rtt)
+        if rtts:
+            doc["heartbeatRttSeconds"] = {
+                p: round(v, 6) for p, v in sorted(rtts.items())}
         degraded = False
         others = self.cmap.others()
         down = [p for p in others if not self.cmap.is_alive(p)]

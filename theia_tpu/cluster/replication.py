@@ -43,6 +43,7 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..obs import metrics as _metrics
+from ..obs import trace as _trace
 from ..store.wal import WalShipGap
 from ..utils.backoff import capped_backoff
 from ..utils.env import env_float, env_int
@@ -392,12 +393,17 @@ class ReplicationLeader:
             raise _NeedsResync(str(e))
         if not frames:
             return False
-        doc = self.transport.request(
-            f.peer, "/cluster/replicate", data=frames,
-            headers={"Content-Type": "application/octet-stream",
-                     "X-Theia-Algo": str(algo),
-                     "X-Theia-Term": str(self.term),
-                     "X-Theia-Leader-Lsn": str(pos)})
+        # each ship batch is a trace root: the follower's apply span
+        # joins it via the traceparent the transport stamps (minted
+        # only when frames actually move — idle polls trace nothing)
+        with _trace.ingress_span("repl.ship", peer=f.peer,
+                                 bytes=len(frames)):
+            doc = self.transport.request(
+                f.peer, "/cluster/replicate", data=frames,
+                headers={"Content-Type": "application/octet-stream",
+                         "X-Theia-Algo": str(algo),
+                         "X-Theia-Term": str(self.term),
+                         "X-Theia-Leader-Lsn": str(pos)})
         if doc.get("needResync"):
             raise _NeedsResync(f"follower {f.peer} requested resync")
         acked = int(doc.get("ackedLsn") or 0)
@@ -427,10 +433,12 @@ class ReplicationLeader:
         payload = pack_resync_stream(position, position_crc, self.term,
                                      records, dedup, _WRITE_ALGO,
                                      _write_crc)
-        doc = self.transport.request(
-            f.peer, "/cluster/resync", data=payload,
-            headers={"Content-Type": "application/octet-stream"},
-            timeout=max(self.transport.timeout, 120.0))
+        with _trace.ingress_span("repl.resync", peer=f.peer,
+                                 bytes=len(payload)):
+            doc = self.transport.request(
+                f.peer, "/cluster/resync", data=payload,
+                headers={"Content-Type": "application/octet-stream"},
+                timeout=max(self.transport.timeout, 120.0))
         acked = int(doc.get("ackedLsn") or 0)
         with self._cond:
             f.acked = acked

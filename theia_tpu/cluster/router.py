@@ -36,6 +36,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..obs import metrics as _metrics
+from ..obs import trace as _trace
 from ..utils.env import env_int
 from ..utils.logging import get_logger
 
@@ -173,13 +174,18 @@ class IngestRouter:
     def forward_all(self, remote: List[Tuple[str, object]],
                     stream: str, seq: Optional[int]) -> List:
         """Start one forward per remote slice; returns futures for
-        `await_all`."""
+        `await_all`. The request thread's trace context is captured
+        HERE (the pool workers run on other threads) so each forward's
+        span — and the traceparent it stamps on the wire — joins the
+        originating ingest trace."""
         sub = self.sub_stream(stream)
-        return [self._pool.submit(self._send, peer, part, sub, seq)
+        ctx = _trace.current_context()
+        return [self._pool.submit(self._send, peer, part, sub, seq,
+                                  ctx)
                 for peer, part in remote]
 
     def _send(self, peer: str, part, sub_stream: str,
-              seq: Optional[int]) -> Dict[str, object]:
+              seq: Optional[int], ctx=None) -> Dict[str, object]:
         import time as _time
 
         from ..store.wal import RECORD_MAGIC, encode_record_body
@@ -190,8 +196,10 @@ class IngestRouter:
         _fire_fault("peer.partition", peer=peer, path="/ingest")
         payload = RECORD_MAGIC + encode_record_body("flows", part)
         t0 = _time.perf_counter()
-        out = self._client(peer).send(payload, seq=seq,
-                                      stream=sub_stream)
+        with _trace.child_span("router.forward", ctx, peer=peer,
+                               rows=len(part)):
+            out = self._client(peer).send(payload, seq=seq,
+                                          stream=sub_stream)
         _M_FWD_SECONDS.observe(_time.perf_counter() - t0)
         _M_FWD_ROWS.labels(peer=peer).inc(len(part))
         _M_FWD_BATCHES.labels(

@@ -29,7 +29,11 @@ pure), so the single silent retry is safe.
 Requests carry `X-Theia-Node` (the sender's id) so the receiving side
 can attribute the hit to a link, and the bearer token when the cluster
 is authenticated (peers authenticate to each other exactly like
-producers do — one token, the deployment's service secret).
+producers do — one token, the deployment's service secret). When the
+calling thread runs inside a SAMPLED trace context (obs/trace.py), a
+`traceparent` header rides along too, so the receiving node's spans
+join the originating trace; unsampled/untraced requests carry no
+header — with tracing disabled the wire is byte-identical.
 """
 
 from __future__ import annotations
@@ -43,6 +47,7 @@ import urllib.error
 import urllib.parse
 from typing import Dict, List, Optional, Tuple
 
+from ..obs import trace as _trace
 from ..utils.faults import fire as _fire_fault
 from ..utils.logging import get_logger
 
@@ -161,6 +166,9 @@ class ClusterTransport:
         h = {NODE_HEADER: self.cmap.self_id}
         if self.token:
             h["Authorization"] = f"Bearer {self.token}"
+        tp = _trace.traceparent()
+        if tp:
+            h["traceparent"] = tp
         if extra:
             h.update(extra)
         return h

@@ -40,6 +40,7 @@ import urllib.request
 import uuid
 from typing import Callable, Dict, Optional
 
+from ..obs import trace as _trace
 from ..utils.backoff import jittered_backoff
 from ..utils.logging import get_logger
 
@@ -141,10 +142,18 @@ class IngestClient:
         self.redirects += 1
         return True
 
-    def _headers(self) -> Dict[str, str]:
-        h = {"Content-Type": "application/octet-stream"}
+    def _headers(self, content_type: str = "application/octet-stream"
+                 ) -> Dict[str, str]:
+        h = {"Content-Type": content_type}
         if self.token:
             h["Authorization"] = f"Bearer {self.token}"
+        # a router forward running inside a sampled trace context
+        # stamps the context on the wire, so the owner node's spans
+        # join the originating trace; producers outside any trace (the
+        # CLI, the bench) add nothing — the wire is unchanged
+        tp = _trace.traceparent()
+        if tp:
+            h["traceparent"] = tp
         return h
 
     def send(self, payload: bytes, seq: Optional[int] = None,
@@ -269,11 +278,24 @@ class IngestClient:
         Retry-After, 307/308 re-target at the node named in Location.
         Unlike `send()` this carries no ingest ledger or seq contract;
         it is for idempotent control/read calls."""
+        raw = self.request_raw(method, path, doc=doc, timeout=timeout)
+        return json.loads(raw) if raw else {}
+
+    def request_text(self, method: str, path: str,
+                     timeout: Optional[float] = None) -> str:
+        """`request_json` for text bodies (the Prometheus exposition
+        `theia top --cluster` scrapes per node) — same failover/
+        redirect/backoff machinery, no JSON decode."""
+        return self.request_raw(method, path,
+                                timeout=timeout).decode(
+                                    errors="replace")
+
+    def request_raw(self, method: str, path: str,
+                    doc: Optional[Dict] = None,
+                    timeout: Optional[float] = None) -> bytes:
         payload = (json.dumps(doc).encode() if doc is not None
                    else None)
-        headers = {"Content-Type": "application/json"}
-        if self.token:
-            headers["Authorization"] = f"Bearer {self.token}"
+        headers = self._headers(content_type="application/json")
         last: Optional[str] = None
         redirects_left = len(self.addrs) + 4
         for attempt in range(1, self.max_attempts + 1):
@@ -284,8 +306,7 @@ class IngestClient:
                 with urllib.request.urlopen(
                         req, timeout=timeout or self.timeout,
                         context=self._ctx) as resp:
-                    raw = resp.read()
-                return json.loads(raw) if raw else {}
+                    return resp.read()
             except urllib.error.HTTPError as e:
                 body = e.read().decode(errors="replace")
                 if e.code in (307, 308):

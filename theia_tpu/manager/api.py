@@ -50,6 +50,7 @@ from typing import Dict, Optional, Tuple
 from .collect import AsyncCollector
 from ..obs import metrics as _obs_metrics
 from ..obs import prom as _obs_prom
+from ..obs import trace as _obs_trace
 from .jobs import (
     KIND_DD,
     KIND_FPM,
@@ -439,10 +440,27 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
             self._send_metrics()
             return
         if parts == ("debug", "traces"):
-            # Recent + slowest spans; same sensitivity class.
+            # Recent + slowest spans; same sensitivity class. With
+            # ?trace=<id> the lookup is CLUSTER-AWARE: this node fans
+            # out to live peers and stitches every node's spans for
+            # that trace into one doc (&local=1 marks a peer-internal
+            # lookup so the fan-out never recurses).
             self._require_auth()
-            limit = int(self._query().get("limit", "100"))
+            q = self._query()
+            trace_id = q.get("trace", "").strip()
+            if trace_id:
+                local_only = q.get("local", "") in ("1", "true")
+                self._send_json(self._trace_doc(trace_id, local_only))
+                return
+            limit = int(q.get("limit", "100"))
             self._send_json(_obs_prom.traces_doc(limit))
+            return
+        if parts == ("debug", "slow_queries"):
+            # Captured slow-query profiles carry plans (flow
+            # identities) — token-gated like /debug/traces.
+            self._require_auth()
+            from ..query.explain import SLOW_QUERIES
+            self._send_json(SLOW_QUERIES.doc())
             return
         if parts == ("query",):
             # Aggregation results decode flow identities (IPs, pods) —
@@ -451,10 +469,11 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
             # admission pressure ladder (heavy reads shed at the
             # shed_detector rung, 429 + Retry-After).
             self._require_auth()
+            q = self._query()
             self._serve_query(
                 self._plan_from_get(),
-                use_cache=self._cache_flag(
-                    self._query().get("cache", "1")))
+                use_cache=self._cache_flag(q.get("cache", "1")),
+                explain=self._explain_flag(q.get("explain")))
             return
         if parts == ("cluster", "ping"):
             # peer liveness + log-matching handshake; open (the
@@ -673,6 +692,71 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
             return {"ready": False, "reason": str(e)}, 503
         return {"ready": True}, 200
 
+    def _trace_doc(self, trace_id: str,
+                   local_only: bool) -> Dict[str, object]:
+        """One trace's spans — local ring plus (unless `local_only`)
+        every live peer's, fetched over the persistent cluster
+        transport and stitched into one doc. Per-span `node` ids come
+        from each recording process; timestamps are each node's OWN
+        wall clock, so cross-node ordering inside the skew envelope is
+        noted, not 'corrected' — fabricating an ordering would be a
+        lie the renderer cannot check."""
+        import urllib.parse
+
+        from ..obs import trace as _t
+        quoted = urllib.parse.quote(trace_id, safe="")
+        spans = _t.spans_for_trace(trace_id)
+        self_id = _t.node_id() or "local"
+        for s in spans:
+            if not s.get("node"):
+                s["node"] = self_id
+        doc: Dict[str, object] = {"trace": trace_id}
+        cluster = getattr(self, "cluster", None)
+        if cluster is not None and not local_only:
+            from ..utils.pool import get_pool
+            failed = []
+            live = [p for p in cluster.cmap.others()
+                    if cluster.cmap.is_alive(p)]
+            failed.extend(p for p in cluster.cmap.others()
+                          if p not in live)
+            # concurrent fetches (the query fan-out discipline): one
+            # hung peer costs one transport timeout, not its place in
+            # a serial chain
+            pool = get_pool("trace-fanout", 4)
+            futs = [(p, pool.submit(
+                cluster.transport.request, p,
+                f"/debug/traces?trace={quoted}&local=1"))
+                for p in live]
+            for peer, fut in futs:
+                try:
+                    remote = fut.result()
+                except Exception as e:
+                    failed.append(peer)
+                    logger.warning("trace fetch from %s failed: %s",
+                                   peer, e)
+                    continue
+                # dedupe on span id: in-process test meshes share one
+                # process-global ring, and a real peer re-answering a
+                # retried fetch must not double its spans either
+                seen = {s.get("spanId") for s in spans}
+                for s in remote.get("spans") or []:
+                    if s.get("spanId") in seen:
+                        continue
+                    if not s.get("node"):
+                        s["node"] = peer
+                    spans.append(s)
+            if failed:
+                doc["peersMissing"] = sorted(failed)
+        spans.sort(key=lambda s: (s.get("startTime") or 0))
+        doc["spans"] = spans
+        doc["nodes"] = sorted({str(s.get("node")) for s in spans})
+        if len(doc["nodes"]) > 1:
+            doc["clockNote"] = (
+                "span timestamps are per-node wall clocks; cross-node "
+                "ordering within the nodes' clock skew is as-reported, "
+                "not corrected")
+        return doc
+
     def _get_dashboard(self, parts) -> None:
         """/dashboards/[<name>] → HTML page;
         /dashboards/api/<name>[?start=..&end=..&limit=..&k=..] → the
@@ -804,12 +888,23 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
         execution, not cache hits."""
         return str(raw).strip().lower() not in ("0", "false", "no")
 
-    def _serve_query(self, plan, use_cache: bool = True) -> None:
+    @staticmethod
+    def _explain_flag(raw) -> bool:
+        """`explain=1|true|yes` (GET param) / `"explain": true` (POST
+        body): attach the execution profile to the result doc."""
+        if raw is True:
+            return True
+        return str(raw).strip().lower() in ("1", "true", "yes")
+
+    def _serve_query(self, plan, use_cache: bool = True,
+                     explain: bool = False) -> None:
         """Shared GET/POST /query tail: admission, execution, timing
         headers. 400s (PlanError is a ValueError) and 429s surface
         through the verb handlers' taxonomy. On a routing-mesh node
         the query coordinator scatter-gathers the whole cluster;
-        everywhere else the local engine answers."""
+        everywhere else the local engine answers. The request's
+        traceparent (if any) flows into the engine's ingress span, so
+        a caller-supplied trace continues through the fan-out."""
         if self.queries is None:
             raise KeyError(self.path)
         if self.cluster is not None:
@@ -822,7 +917,9 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
             adm.admit_query()
         dist = getattr(self, "distqueries", None)
         engine = dist if dist is not None else self.queries
-        self._send_json(engine.execute(plan, use_cache=use_cache))
+        self._send_json(engine.execute(
+            plan, use_cache=use_cache, explain=explain,
+            traceparent=self.headers.get("traceparent")))
 
     def _send_ingest_redirect(self) -> None:
         """307 + Location at the current leader: this node is a
@@ -853,7 +950,8 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
             body = self._read_body()
             self._serve_query(
                 parse_plan(body),
-                use_cache=self._cache_flag(body.get("cache", "1")))
+                use_cache=self._cache_flag(body.get("cache", "1")),
+                explain=self._explain_flag(body.get("explain")))
             return
         if parts == ("query", "partial"):
             self._post_query_partial()
@@ -876,8 +974,9 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
             payload = self._read_raw_body()
             if not payload:
                 raise ValueError("empty ingest payload")
-            self._send_json(self.ingest.ingest(payload, stream=stream,
-                                               seq=seq))
+            self._send_json(self.ingest.ingest(
+                payload, stream=stream, seq=seq,
+                traceparent=self.headers.get("traceparent")))
             return
         if parts and parts[0] == "cluster":
             self._post_cluster(parts)
@@ -928,7 +1027,14 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
             adm.admit_query()
         node_id = (self.cluster.cmap.self_id
                    if self.cluster is not None else "")
-        raw = serve_partial(self.queries, plan, node_id=node_id)
+        # trace ingress: the coordinator's context arrives on the
+        # request, so this node's partial-execution span joins the
+        # originating query's cross-node trace
+        with _obs_trace.ingress_span(
+                "query.partial",
+                traceparent=self.headers.get("traceparent"),
+                coordinator=self.headers.get(NODE_HEADER) or ""):
+            raw = serve_partial(self.queries, plan, node_id=node_id)
         self.send_response(200)
         self.send_header("Content-Type", "application/octet-stream")
         self.send_header("Content-Length", str(len(raw)))
@@ -941,24 +1047,31 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
         frames, /cluster/resync a wholesale catch-up stream,
         /cluster/promote the WAL-delimited failover cutover."""
         from ..cluster.transport import NODE_HEADER, fire_recv
-        if self.cluster is None:
+        if self.cluster is None or len(parts) < 2:
             raise KeyError(self.path)
         fire_recv(self.headers.get(NODE_HEADER),
                   "/" + "/".join(parts))
-        if parts == ("cluster", "replicate"):
-            self._send_json(self.cluster.handle_replicate(
-                self._read_raw_body(), self.headers))
-            return
-        if parts == ("cluster", "resync"):
-            self._send_json(self.cluster.handle_resync(
-                self._read_raw_body(), self.headers))
-            return
-        if parts == ("cluster", "promote"):
-            body = self._read_body()
-            at = body.get("atLsn")
-            self._send_json(self.cluster.promote(
-                int(at) if at is not None else None))
-            return
+        # trace ingress: a leader's ship/resync span context arrives
+        # on the request (cluster/replication.py mints it), so the
+        # apply side of every replication RPC joins the same trace
+        op = "cluster." + parts[1]
+        with _obs_trace.ingress_span(
+                op, traceparent=self.headers.get("traceparent"),
+                peer=self.headers.get(NODE_HEADER) or ""):
+            if parts == ("cluster", "replicate"):
+                self._send_json(self.cluster.handle_replicate(
+                    self._read_raw_body(), self.headers))
+                return
+            if parts == ("cluster", "resync"):
+                self._send_json(self.cluster.handle_resync(
+                    self._read_raw_body(), self.headers))
+                return
+            if parts == ("cluster", "promote"):
+                body = self._read_body()
+                at = body.get("atLsn")
+                self._send_json(self.cluster.promote(
+                    int(at) if at is not None else None))
+                return
         raise KeyError(self.path)
 
     def _delete(self) -> None:
@@ -1152,6 +1265,10 @@ class TheiaManagerServer:
                 self_id=cluster_self, role=cluster_role,
                 acks=cluster_acks, token=self.auth_token or "",
                 query_engine=self.queries)
+            # stamp this node's id on every span it records, so the
+            # cluster-stitched trace view attributes each span to the
+            # node that ran it
+            _obs_trace.set_node_id(self.cluster.cmap.self_id)
             # Scatter-gather /query on the routing mesh: data is
             # spread by destination hash, so the receiving node
             # coordinates a cluster-wide answer (leader/follower

@@ -493,10 +493,20 @@ class IngestManager:
                 del self._streams[stream_id]
 
     def ingest(self, payload: bytes, stream: str = "default",
-               seq: Optional[int] = None) -> Dict[str, object]:
+               seq: Optional[int] = None,
+               traceparent: Optional[str] = None
+               ) -> Dict[str, object]:
         """Decode one wire payload, insert ∥ score. Raises ValueError on
         malformed payloads (mapped to HTTP 400 by the API layer); the
         failing stream is reset and must restart its encoder.
+
+        This is a trace INGRESS: a fresh trace context is minted (or
+        adopted from `traceparent` — a router forward carries its
+        origin's), every nested operation joins it, and the sampled
+        trace id rides back in the ack as `traceId` so `theia trace
+        <id>` can pull the stitched cross-node tree. An unsampled (or
+        THEIA_TRACE_SAMPLE=0) request records nothing and adds no
+        wire bytes.
 
         `seq` is the producer's monotone batch sequence number within
         its stream: a retry of an already-acknowledged (stream, seq) —
@@ -510,6 +520,27 @@ class IngestManager:
         batch; under the brownout ladder's degraded rungs the
         detector/scoring leg is sampled or shed while rows stay
         durable (WAL + store) and acknowledged."""
+        # THEIA_TRACE_SAMPLE_INGEST dials THIS ingress independently:
+        # ingest runs orders of magnitude hotter than queries or
+        # replication, and an un-dialed 1.0 rate would churn the
+        # bounded span ring in seconds at production batch rates
+        with _trace.ingress_span("ingest.request",
+                                 traceparent=traceparent,
+                                 sample_env="THEIA_TRACE_SAMPLE_INGEST",
+                                 stream=stream) as sp:
+            out = self._ingest_span_body(payload, stream, seq)
+            sp.attrs["rows"] = out.get("rows", 0)
+            if out.get("alerts"):
+                sp.attrs["alerts"] = out["alerts"]
+            if out.get("duplicate"):
+                sp.attrs["duplicate"] = True
+            ctx = _trace.current_context()
+            if ctx is not None:
+                out["traceId"] = ctx.trace_id
+            return out
+
+    def _ingest_span_body(self, payload: bytes, stream: str,
+                          seq: Optional[int]) -> Dict[str, object]:
         t_req = time.perf_counter()
         if seq is not None:
             seq = int(seq)
@@ -797,11 +828,9 @@ class IngestManager:
             _M_ALERTS.labels(kind="connection_anomaly").inc(n_conn)
         dt_req = time.perf_counter() - t_req
         _M_REQUEST.observe(dt_req)
-        # Flight-record slow requests only: publishing every batch
-        # would wash real incidents out of the bounded span ring.
-        if dt_req >= self.TRACE_SLOW_SECONDS:
-            _trace.record("ingest.request", now - dt_req, dt_req,
-                          stream=stream, rows=total, alerts=n_alerts)
+        # the enclosing ingress span (ingest()) is the flight record
+        # now — sampled requests publish with trace context attached;
+        # tune THEIA_TRACE_SAMPLE down instead of a slow-only filter
         if n_alerts:
             logger.v(1).info("ingested %d rows, %d alerts", n, n_alerts)
         out: Dict[str, object] = {"rows": total, "alerts": n_alerts}
@@ -814,10 +843,6 @@ class IngestManager:
             # alert absence under brownout is degradation, not quiet
             out["degraded"] = LEVEL_NAMES[level]
         return out
-
-    #: requests at least this slow land in the trace ring as
-    #: "ingest.request" spans (fast ones only move the histograms)
-    TRACE_SLOW_SECONDS = 0.1
 
     def _timed_insert(self, batch: ColumnarBatch,
                       dedup: Optional[Tuple[str, int]] = None) -> int:

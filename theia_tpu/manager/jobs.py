@@ -439,9 +439,11 @@ class JobController:
         logger.v(1).info("job %s started (%s, attempt %d)", record.name,
                          self.dispatch, record.attempts)
         try:
-            with _obs_trace.span("job.run", job=record.name,
-                                 kind=record.kind,
-                                 attempt=record.attempts):
+            # a trace ingress: each run is its own trace root, so the
+            # spans of whatever the job touches stitch under one id
+            with _obs_trace.ingress_span("job.run", job=record.name,
+                                         kind=record.kind,
+                                         attempt=record.attempts):
                 if self.dispatch == "subprocess":
                     self._run_subprocess(record)
                 else:

@@ -24,4 +24,11 @@ from .metrics import (  # noqa: F401
     gauge,
     histogram,
 )
-from .trace import span, traced  # noqa: F401
+from .trace import (  # noqa: F401
+    child_span,
+    current_context,
+    ingress_span,
+    span,
+    traced,
+    traceparent,
+)

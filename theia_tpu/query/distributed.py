@@ -67,6 +67,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..obs import metrics as _metrics
+from ..obs import trace as _trace
 from ..schema import FLOW_SCHEMA, ColumnarBatch, StringDictionary
 from ..utils.env import env_float
 from ..utils.logging import get_logger
@@ -75,9 +76,11 @@ from .engine import (
     _M_CACHE_HITS,
     _M_CACHE_MISSES,
     QueryCache,
+    QueryEngine,
     QueryError,
     merge_materialized,
 )
+from .explain import SLOW_QUERIES, QueryProfiler
 from .plan import QueryPlan
 from .result import empty_result, finalize, lower_specs
 
@@ -254,7 +257,25 @@ class ClusterQueryCoordinator:
     # -- execution ---------------------------------------------------------
 
     def execute(self, plan: QueryPlan,
-                use_cache: bool = True) -> Dict[str, object]:
+                use_cache: bool = True,
+                explain: bool = False,
+                traceparent: Optional[str] = None
+                ) -> Dict[str, object]:
+        """Coordinate one cluster-wide query. This is a trace ingress:
+        the fan-out's `/query/partial` requests carry the minted (or
+        adopted) context, so every peer's partial-execution spans join
+        ONE cross-node trace. `explain=True` attaches the coordinator
+        profile (per-peer timings/bytes/degraded reasons, merge and
+        top-K time) without changing the result rows."""
+        with _trace.ingress_span("query.request", engine="cluster",
+                                 traceparent=traceparent) as sp:
+            doc = self._execute_traced(plan, use_cache, explain)
+            sp.attrs["groups"] = doc.get("groupCount")
+            sp.attrs["cache"] = doc.get("cache")
+            return doc
+
+    def _execute_traced(self, plan: QueryPlan, use_cache: bool,
+                        explain: bool) -> Dict[str, object]:
         t0 = time.perf_counter()
         others = self.cmap.others()
         epoch = self.cmap.membership_epoch()
@@ -265,7 +286,8 @@ class ClusterQueryCoordinator:
         candidates = [p for p in others if p not in pruned]
         live = [p for p in candidates if self.cmap.is_alive(p)]
         down = [p for p in candidates if p not in live]
-        key = (plan.normalized(), self.engine.fingerprint(), epoch,
+        local_fp = self.engine.fingerprint()
+        key = (plan.normalized(), local_fp, epoch,
                tuple(sorted((p, peer_store[p].get("fingerprint"))
                             for p in others)))
         caching = use_cache and self.cache.max_bytes > 0
@@ -277,6 +299,14 @@ class ClusterQueryCoordinator:
                 doc["cache"] = "hit"
                 doc["tookMs"] = round(
                     (time.perf_counter() - t0) * 1000, 3)
+                QueryEngine._stamp_trace(doc)
+                if explain:
+                    doc["profile"] = {
+                        "engine": "cluster",
+                        "cache": "hit",
+                        "fingerprint":
+                            self.engine.fingerprint_hash(local_fp),
+                    }
                 return doc
             _M_CACHE_MISSES.inc()
         if down and strict_mode():
@@ -289,30 +319,60 @@ class ClusterQueryCoordinator:
                 f"(THEIA_QUERY_STRICT=1)")
         with self._lock:
             self.fanouts += 1
+        prof = QueryProfiler.maybe(explain)
+        # the pool workers run on other threads: hand them the trace
+        # context so each peer fetch (and the traceparent it stamps)
+        # joins this query's trace
+        ctx = _trace.current_context()
         futs = []
         if live:
             pool = get_pool("query-fanout", self.workers)
-            futs = [(p, pool.submit(self._fetch_partial, p, plan))
+            futs = [(p, pool.submit(self._fetch_partial, p, plan,
+                                    ctx))
                     for p in live]
         # local partial executes on the coordinator thread while the
-        # fan-out is in flight
+        # fan-out is in flight (sharing `prof`, so the local store's
+        # per-part scanned/pruned detail lands in the profile)
         stats = {"rowsScanned": 0, "partsScanned": 0, "partsPruned": 0}
-        results = [self.engine.execute_partial(plan, stats)]
+        results = [self.engine.execute_partial(plan, stats, prof)]
         failed: List[str] = []
+        peer_errors: Dict[str, str] = {}
         bytes_shipped = 0
         for peer, fut in futs:
             try:
                 meta, keys, aggs = fut.result()
             except Exception as e:
                 failed.append(peer)
+                peer_errors[peer] = f"{type(e).__name__}: {e}"
                 logger.warning("partial from peer %s failed: %s: %s",
                                peer, type(e).__name__, e)
                 continue
             bytes_shipped += int(meta.get("_bytes") or 0)
             for k in stats:
                 stats[k] += int(meta.get(k) or 0)
+            if prof is not None:
+                prof.add_peer(
+                    peer, "queried",
+                    tookMs=round(float(meta.get("_tookMs") or 0.0), 3),
+                    execMs=meta.get("execMs"),
+                    bytes=int(meta.get("_bytes") or 0),
+                    rowsScanned=int(meta.get("rowsScanned") or 0),
+                    partsScanned=int(meta.get("partsScanned") or 0),
+                    partsPruned=int(meta.get("partsPruned") or 0),
+                    fingerprint=meta.get("fingerprint"))
             results.append((keys, aggs))
         missing = sorted(down + failed)
+        if prof is not None:
+            for p in pruned:
+                prof.add_peer(p, "pruned",
+                              bounds=(peer_store[p].get("bounds")
+                                      or None))
+            for p in down:
+                prof.add_peer(p, "down",
+                              reason="no heartbeat inside the "
+                                     "liveness timeout")
+            for p in failed:
+                prof.add_peer(p, "failed", reason=peer_errors.get(p))
         _M_PEERS_QUERIED.inc(len(live) - len(failed))
         _M_PEERS_PRUNED.inc(len(pruned))
         _M_PEERS_FAILED.inc(len(missing))
@@ -322,11 +382,16 @@ class ClusterQueryCoordinator:
                 f"distributed query incomplete: peers "
                 f"{','.join(missing)} unavailable "
                 f"(THEIA_QUERY_STRICT=1)")
+        t_merge = time.perf_counter()
         keys, aggs = merge_materialized(plan, results)
+        t_fin = time.perf_counter()
         if aggs is None or not len(next(iter(aggs.values()))):
             rows, groups = empty_result(plan)
         else:
             rows, groups = finalize(plan, keys, aggs)
+        if prof is not None:
+            prof.phase("merge", t_fin - t_merge)
+            prof.phase("finalize", time.perf_counter() - t_fin)
         took = time.perf_counter() - t0
         _M_FANOUT_SECONDS.observe(took)
         doc: Dict[str, object] = {
@@ -354,23 +419,51 @@ class ClusterQueryCoordinator:
                 self.partial_results += 1
         # cache only COMPLETE results whose key truly covers every
         # peer's state: a peer without a heartbeat-reported
-        # fingerprint could change under an unchanged key
+        # fingerprint could change under an unchanged key — and never
+        # the profile (a later hit would serve a stale per-peer story)
         if caching and not missing and all(
                 peer_store[p].get("fingerprint") for p in others):
             self.cache.store(key, doc)
+            doc = dict(doc)
+        QueryEngine._stamp_trace(doc)   # before slow capture
+        profile = None
+        if prof is not None:
+            profile = prof.doc(
+                engine="cluster",
+                cache=doc["cache"],
+                fingerprint=self.engine.fingerprint_hash(local_fp),
+                rowsScanned=stats["rowsScanned"],
+                partsScanned=stats["partsScanned"],
+                partsPruned=stats["partsPruned"],
+                bytesShipped=bytes_shipped,
+            )
+            # the matched count (and any per-part detail) covers the
+            # COORDINATOR'S local store only — peers profile their
+            # own executions; label it so
+            matched = profile.pop("rowsMatched", None)
+            if matched is not None:
+                profile["rowsMatchedLocal"] = matched
+            SLOW_QUERIES.observe(plan, doc, prof, profile)
+        if explain and profile is not None:
+            doc["profile"] = profile
         return doc
 
-    def _fetch_partial(self, peer: str, plan: QueryPlan):
+    def _fetch_partial(self, peer: str, plan: QueryPlan, ctx=None):
         """One peer's partial over the cluster transport (persistent
         connection; `net.send`/`peer.partition` fault sites fire
-        inside, so partition drills sever the read path too)."""
-        raw = self.transport.request_raw(
-            peer, "/query/partial",
-            data=json.dumps({"plan": plan.to_doc()}).encode(),
-            headers={"Content-Type": "application/json"},
-            timeout=self.timeout)
+        inside, so partition drills sever the read path too). Runs on
+        a pool worker: `ctx` is the coordinator request's trace
+        context, re-activated here so the wire request carries it."""
+        t0 = time.perf_counter()
+        with _trace.child_span("query.fanout", ctx, peer=peer):
+            raw = self.transport.request_raw(
+                peer, "/query/partial",
+                data=json.dumps({"plan": plan.to_doc()}).encode(),
+                headers={"Content-Type": "application/json"},
+                timeout=self.timeout)
         meta, batch = unpack_partial(raw)
         meta["_bytes"] = len(raw)
+        meta["_tookMs"] = (time.perf_counter() - t0) * 1000
         keys, aggs = partial_from_batch(plan, batch)
         return meta, keys, aggs
 
@@ -398,9 +491,13 @@ def serve_partial(engine, plan: QueryPlan,
     execute the local partial and pack the TQPF frame. The meta
     carries this node's scan stats (the coordinator sums them into
     the result doc) and its CURRENT store fingerprint."""
+    t0 = time.perf_counter()
     stats = {"rowsScanned": 0, "partsScanned": 0, "partsPruned": 0}
     keys, aggs = engine.execute_partial(plan, stats)
     _M_PARTIALS_SERVED.inc()
     meta: Dict[str, object] = {"node": node_id, **stats,
-                               "fingerprint": engine.fingerprint_hash()}
+                               "fingerprint": engine.fingerprint_hash(),
+                               "execMs": round(
+                                   (time.perf_counter() - t0) * 1000,
+                                   3)}
     return pack_partial(meta, plan, keys, aggs)

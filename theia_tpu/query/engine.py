@@ -57,11 +57,13 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..obs import metrics as _metrics
+from ..obs import trace as _trace
 from ..schema import ColumnarBatch
 from ..utils.env import env_int
 from ..utils.logging import get_logger
 from ..utils.pool import get_pool
 from . import kernels
+from .explain import SLOW_QUERIES, QueryProfiler
 from .plan import QueryPlan
 from .reference import filter_mask, materialize_keys, reference_partial
 from .result import empty_result, finalize, lower_specs, value_columns
@@ -342,26 +344,55 @@ class QueryEngine:
             tables = self._tables()
         return tuple(self._table_state(t) for t in tables)
 
-    def fingerprint_hash(self) -> str:
+    def fingerprint_hash(self, fingerprint: Optional[tuple] = None
+                         ) -> str:
         """Compact digest of `fingerprint()` — what cluster heartbeats
         piggyback so a query coordinator can key its cluster-wide
         result cache on per-peer store states (any seal/merge/demote/
-        insert/delete on any node moves its digest)."""
+        insert/delete on any node moves its digest). Pass an
+        already-computed fingerprint to digest the exact state an
+        execution keyed on (EXPLAIN profiles do)."""
+        if fingerprint is None:
+            fingerprint = self.fingerprint()
         return hashlib.sha1(
-            repr(self.fingerprint()).encode()).hexdigest()[:16]
+            repr(fingerprint).encode()).hexdigest()[:16]
 
     # -- public API --------------------------------------------------------
 
     def execute(self, plan: QueryPlan,
-                use_cache: bool = True) -> Dict[str, object]:
+                use_cache: bool = True,
+                explain: bool = False,
+                traceparent: Optional[str] = None
+                ) -> Dict[str, object]:
         """Run one plan; returns the result doc. Raises PlanError
         (from parsing, upstream), QueryError, or the store's
-        availability errors."""
+        availability errors. `explain=True` attaches the execution
+        profile (query/explain.py) WITHOUT re-running anything — the
+        result rows are bit-identical either way; `traceparent`
+        adopts a caller's trace context (this is a trace ingress)."""
+        with _trace.ingress_span("query.request",
+                                 traceparent=traceparent) as sp:
+            doc = self._execute_traced(plan, use_cache, explain)
+            sp.attrs["groups"] = doc.get("groupCount")
+            sp.attrs["cache"] = doc.get("cache")
+            return doc
+
+    @staticmethod
+    def _stamp_trace(doc: Dict[str, object]) -> None:
+        """Attach the current sampled trace id to a result doc (the
+        caller's handle into `theia trace <id>`)."""
+        ctx = _trace.current_context()
+        if ctx is not None:
+            doc["traceId"] = ctx.trace_id
+
+    def _execute_traced(self, plan: QueryPlan, use_cache: bool,
+                        explain: bool) -> Dict[str, object]:
         with self._lock:
             self.queries += 1
         t0 = time.perf_counter()
         tables = self._tables()
-        key = (plan.normalized(), self.fingerprint(tables))
+        fp = self.fingerprint(tables)
+        key = (plan.normalized(), fp)
         # a disabled cache (THEIA_QUERY_CACHE_BYTES=0) reports "off",
         # not a permanent 0% hit ratio that reads as a broken cache
         caching = use_cache and self.cache.max_bytes > 0
@@ -376,10 +407,23 @@ class QueryEngine:
                 # read the slow path for a microsecond hit
                 doc["tookMs"] = round(
                     (time.perf_counter() - t0) * 1000, 3)
+                self._stamp_trace(doc)
+                if explain:
+                    # a hit has no per-part story to tell — the honest
+                    # profile is "served from cache under this state"
+                    doc["profile"] = {
+                        "engine": doc.get("engine"),
+                        "cache": "hit",
+                        "fingerprint": self.fingerprint_hash(fp),
+                    }
                 return doc
             _M_CACHE_MISSES.inc()
+        prof = QueryProfiler.maybe(explain)
         stats = {"rowsScanned": 0, "partsScanned": 0, "partsPruned": 0}
-        keys, aggs = self._partial_for_tables(plan, tables, stats)
+        t_exec = time.perf_counter()
+        keys, aggs = self._partial_for_tables(plan, tables, stats,
+                                              prof)
+        t_fin = time.perf_counter()
         if aggs is None or _n_groups(aggs) == 0:
             rows, groups = empty_result(plan)
         else:
@@ -403,7 +447,27 @@ class QueryEngine:
             "cache": "miss" if caching else "off",
         }
         if caching:
+            # the cached doc carries no profile or trace id: a later
+            # hit under the same key would serve a stale one
             self.cache.store(key, doc)
+            doc = dict(doc)
+        self._stamp_trace(doc)   # BEFORE slow capture: entries link
+        profile = None           # back via theia trace <id>
+        if prof is not None:
+            prof.phase("execute", t_fin - t_exec)
+            prof.phase("finalize", time.perf_counter() - t_fin)
+            profile = prof.doc(
+                engine=doc["engine"],
+                kernel=kernels.kernel_mode(),
+                cache=doc["cache"],
+                fingerprint=self.fingerprint_hash(fp),
+                rowsScanned=stats["rowsScanned"],
+                partsScanned=stats["partsScanned"],
+                partsPruned=stats["partsPruned"],
+            )
+            SLOW_QUERIES.observe(plan, doc, prof, profile)
+        if explain and profile is not None:
+            doc["profile"] = profile
         return doc
 
     def stats(self) -> Dict[str, object]:
@@ -417,7 +481,8 @@ class QueryEngine:
         }
 
     def execute_partial(self, plan: QueryPlan,
-                        stats: Optional[Dict[str, int]] = None
+                        stats: Optional[Dict[str, int]] = None,
+                        prof: Optional[QueryProfiler] = None
                         ) -> Tuple[Optional[List[np.ndarray]],
                                    Optional[Dict[str, np.ndarray]]]:
         """One node's share of a distributed query: (materialized
@@ -429,95 +494,114 @@ class QueryEngine:
         if stats is None:
             stats = {"rowsScanned": 0, "partsScanned": 0,
                      "partsPruned": 0}
-        return self._partial_for_tables(plan, self._tables(), stats)
+        return self._partial_for_tables(plan, self._tables(), stats,
+                                        prof)
 
     # -- per-table execution -----------------------------------------------
 
-    def _partial_for_tables(self, plan: QueryPlan, tables, stats
+    def _partial_for_tables(self, plan: QueryPlan, tables, stats,
+                            prof: Optional[QueryProfiler] = None
                             ) -> Tuple[Optional[List[np.ndarray]],
                                        Optional[Dict[str, np.ndarray]]]:
-        table_results = [self._execute_table(plan, t, stats)
+        table_results = [self._execute_table(plan, t, stats, prof)
                          for t in tables]
         if len(table_results) == 1:
             return table_results[0]
         return merge_materialized(plan, table_results)
 
-    def _execute_table(self, plan: QueryPlan, table, stats
+    def _execute_table(self, plan: QueryPlan, table, stats,
+                       prof: Optional[QueryProfiler] = None
                        ) -> Tuple[Optional[List[np.ndarray]],
                                   Optional[Dict[str, np.ndarray]]]:
         """One table → (materialized key columns, merged aggregates)
         or (None, None) when nothing survives."""
         if getattr(table, "_parts", None) is None:
-            partial, scanned = self._flat_partial(plan, table)
+            partial, scanned = self._flat_partial(plan, table, prof)
             stats["rowsScanned"] += scanned
         else:
-            partial = self._parts_partials(plan, table, stats)
+            partial = self._parts_partials(plan, table, stats, prof)
         if partial is None:
             return None, None
         uniq, aggs = partial
         keys = materialize_keys(plan, uniq, table.dicts, table.schema)
         return keys, aggs
 
-    def _flat_partial(self, plan, table) -> Tuple[Partial, int]:
+    def _flat_partial(self, plan, table,
+                      prof: Optional[QueryProfiler] = None
+                      ) -> Tuple[Partial, int]:
         """Flat engine: the reference executor over a (column-subset)
         scan — slow but correct, and the parity anchor."""
         cols = plan.columns_touched()
         batch = table.select(columns=cols) if cols else table.scan()
+        if prof is not None and prof.detail and len(batch):
+            # an extra mask evaluation — paid only under an explicit
+            # explain=1, never on the always-on slow-capture profiler
+            prof.add_matched(int(filter_mask(plan, batch,
+                                             table.dicts).sum()))
         return reference_partial(plan, batch, table.dicts), len(batch)
 
-    def _parts_partials(self, plan: QueryPlan, table, stats) -> Partial:
+    def _parts_partials(self, plan: QueryPlan, table, stats,
+                        prof: Optional[QueryProfiler] = None
+                        ) -> Partial:
         """Parts engine: prune → stripe live parts across the worker
         pool (each worker folds its stripe into one partial
         accumulator) → evaluate the memtable via the reference path →
-        merge everything exactly."""
+        merge everything exactly. `prof` (the EXPLAIN profiler)
+        records each part's fate and the prune REASON — the decisions
+        are computed here regardless, so profiling adds bookkeeping,
+        never work."""
         specs = lower_specs(plan)
         filters = [_CompiledFilter(f, table) for f in plan.filters]
         parts, mem = table._snapshot_refs()
         live = []
         pruned = 0
         for p in parts:
+            reason = None
             if not p.overlaps(plan.start, plan.end, plan.time_column,
                               plan.end_column):
-                pruned += 1
-                continue
-            excluded = False
-            for f in filters:
-                if f.is_string:
-                    # dictionary-code pruning (hot parts: the unique
-                    # code set is resident metadata)
-                    if f.excludes_part(p):
-                        excluded = True
+                reason = "time_window"
+            else:
+                for f in filters:
+                    if f.is_string:
+                        # dictionary-code pruning (hot parts: the
+                        # unique code set is resident metadata)
+                        if f.excludes_part(p):
+                            reason = f"codes:{f.column}"
+                            break
+                        continue
+                    if f.op == "ne":
+                        continue
+                    mm = p.minmax.get(f.column)
+                    if mm is not None and _minmax_excludes(
+                            mm, f.op, f.value):
+                        reason = f"range:{f.column}"
                         break
-                    continue
-                if f.op == "ne":
-                    continue
-                mm = p.minmax.get(f.column)
-                if mm is not None and _minmax_excludes(
-                        mm, f.op, f.value):
-                    excluded = True
-                    break
-            if excluded:
+            if reason is not None:
                 pruned += 1
             else:
                 live.append(p)
+            if prof is not None:
+                prof.add_part(p.uid, p.tier, p.rows, pruned=reason)
         partials: List[Partial] = []
         if live:
             stripes = [live[i::self.workers]
                        for i in range(min(self.workers, len(live)))]
             if len(stripes) == 1:
                 partials.append(self._fold_stripe(
-                    plan, table, specs, filters, stripes[0]))
+                    plan, table, specs, filters, stripes[0], prof))
             else:
                 pool = get_pool("query", self.workers)
                 futs = [pool.submit(self._fold_stripe, plan, table,
-                                    specs, filters, s)
+                                    specs, filters, s, prof)
                         for s in stripes]
                 partials.extend(f.result() for f in futs)
         for b in mem:
             if len(b):
                 partials.append(self._decoded_partial(plan, table,
-                                                      specs, b))
+                                                      specs, b, prof))
                 stats["rowsScanned"] += len(b)
+                if prof is not None:
+                    prof.memtable_rows += len(b)
         stats["partsScanned"] += len(live)
         stats["partsPruned"] += pruned
         stats["rowsScanned"] += sum(p.rows for p in live)
@@ -526,10 +610,12 @@ class QueryEngine:
         return merged if len(merged[0]) else None
 
     def _fold_stripe(self, plan, table, specs, filters,
-                     parts: Sequence) -> Partial:
+                     parts: Sequence,
+                     prof: Optional[QueryProfiler] = None) -> Partial:
         """One worker's stripe: evaluate each part, fold the partials
         into a single per-worker accumulator."""
-        partials = [self._part_partial(plan, table, specs, filters, p)
+        partials = [self._part_partial(plan, table, specs, filters, p,
+                                       prof)
                     for p in parts]
         partials = [p for p in partials if p is not None]
         if not partials:
@@ -538,21 +624,26 @@ class QueryEngine:
 
     # -- per-part evaluation -----------------------------------------------
 
-    def _part_partial(self, plan, table, specs, filters, part
+    def _part_partial(self, plan, table, specs, filters, part,
+                      prof: Optional[QueryProfiler] = None
                       ) -> Partial:
         chunks = part.chunks
         if chunks is None:
             if part.tier == "cold":
-                return self._cold_partial(plan, table, specs, part)
+                return self._cold_partial(plan, table, specs, part,
+                                          prof)
             # lazy-recovery hot part: decode (and promote) once, then
             # evaluate in decoded space
             batch = table._decode_part(part)
-            return self._decoded_partial(plan, table, specs, batch)
+            return self._decoded_partial(plan, table, specs, batch,
+                                         prof)
         return self._encoded_partial(plan, table, specs, filters,
-                                     chunks, part.rows)
+                                     chunks, part.rows, prof)
 
     def _encoded_partial(self, plan, table, specs, filters,
-                         chunks, n_rows: int) -> Partial:
+                         chunks, n_rows: int,
+                         prof: Optional[QueryProfiler] = None
+                         ) -> Partial:
         """Hot part, no decode: predicates on width-reduced ints and
         local dictionary indices; group keys aggregate in local code
         space; only surviving groups widen to global codes."""
@@ -591,6 +682,10 @@ class QueryEngine:
         full = mask is True
         if not full and not mask.any():
             return None
+        if prof is not None and prof.detail:
+            # explain-only: the always-on slow-capture profiler must
+            # not tax every query with an extra reduction
+            prof.add_matched(int(n_rows if full else mask.sum()))
 
         def masked(arr: np.ndarray) -> np.ndarray:
             return arr if full else arr[mask]
@@ -626,7 +721,8 @@ class QueryEngine:
                 uniq[:, j] += aux
         return uniq, aggs
 
-    def _cold_partial(self, plan, table, specs, part) -> Partial:
+    def _cold_partial(self, plan, table, specs, part,
+                      prof: Optional[QueryProfiler] = None) -> Partial:
         """Cold part: stream through the bounded decode buffer,
         decoding ONLY the plan's columns from the self-contained part
         file, adopt the subset into table code space, evaluate, drop —
@@ -637,15 +733,20 @@ class QueryEngine:
         cols = plan.columns_touched() or (table.schema[0].name,)
         with self._cold_sem:
             batch = table._decode_part(part, columns=cols)
-            return self._decoded_partial(plan, table, specs, batch)
+            return self._decoded_partial(plan, table, specs, batch,
+                                         prof)
 
     def _decoded_partial(self, plan, table, specs,
-                         batch: ColumnarBatch) -> Partial:
+                         batch: ColumnarBatch,
+                         prof: Optional[QueryProfiler] = None
+                         ) -> Partial:
         """Table-coded batch (memtable, cold subset, lazy part):
         reference-style mask, kernel aggregation — global code space
         throughout, so the partial merges directly with the encoded
         ones."""
         mask = filter_mask(plan, batch, table.dicts)
+        if prof is not None and prof.detail:
+            prof.add_matched(int(mask.sum()))
         if not mask.any():
             return None
         if plan.group_by:
