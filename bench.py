@@ -147,6 +147,23 @@ def _write_artifact(path: str, result: dict) -> None:
     print(f"bench artifact written to {path}", file=sys.stderr)
 
 
+def _leg_stats(times) -> dict:
+    """best/median/spread for one timed leg's per-iteration seconds —
+    recorded under result["leg_stats"] so the 2-core bench host's
+    run-to-run noise (ROADMAP: 12-36k rows/s swings across identical
+    runs) is visible IN the JSON artifact, not just changelog prose.
+    spreadPct = (worst - best) / median."""
+    ts = sorted(float(t) for t in times)
+    med = ts[len(ts) // 2]
+    return {
+        "iterations": len(ts),
+        "bestMs": round(ts[0] * 1e3, 3),
+        "medianMs": round(med * 1e3, 3),
+        "spreadPct": round((ts[-1] - ts[0]) / med * 100, 1)
+        if med > 0 else 0.0,
+    }
+
+
 def main() -> None:
     """Always prints exactly one JSON result line on stdout, whatever
     fails or HANGS. The orchestrator (this function) owns no JAX state;
@@ -1214,6 +1231,9 @@ def run_benchmarks() -> dict:
     # speedup, cold-tier scan rate (with a no-promotion check), and
     # cache-hit latency. THEIA_BENCH_FAST runs a one-window smoke.
     query_bench: dict = {}
+    #: per-leg {bestMs, medianMs, spreadPct} for multi-iteration timed
+    #: legs — lands in the --out artifact under result.leg_stats
+    leg_stats: dict = {}
     query_parity_ok = None
     try:
         import shutil
@@ -1273,16 +1293,19 @@ def run_benchmarks() -> dict:
         if query_parity_ok:
             # group-sum through the engine vs decode-then-aggregate
             iters = 1 if fastq else 3
-            best_q = best_base = float("inf")
+            t_q: list = []
+            t_base: list = []
             for _ in range(iters):
                 tq = time.perf_counter()
                 eng_p.execute(groupsum, use_cache=False)
-                best_q = min(best_q, time.perf_counter() - tq)
+                t_q.append(time.perf_counter() - tq)
                 tq = time.perf_counter()
                 reference_execute(groupsum, qparts.flows.scan(),
                                   qparts.flows.dicts)
-                best_base = min(best_base,
-                                time.perf_counter() - tq)
+                t_base.append(time.perf_counter() - tq)
+            best_q, best_base = min(t_q), min(t_base)
+            leg_stats["query_groupsum"] = _leg_stats(t_q)
+            leg_stats["query_baseline"] = _leg_stats(t_base)
             query_bench["query_groupsum_rows_per_sec"] = round(
                 n_qrows / best_q)
             query_bench["query_baseline_rows_per_sec"] = round(
@@ -1291,15 +1314,18 @@ def run_benchmarks() -> dict:
                 best_base / best_q, 1)
 
             # pruned narrow window vs the same query decoded
-            best_qw = best_bw = float("inf")
+            t_qw: list = []
+            t_bw: list = []
             for _ in range(iters):
                 tq = time.perf_counter()
                 eng_p.execute(windowed, use_cache=False)
-                best_qw = min(best_qw, time.perf_counter() - tq)
+                t_qw.append(time.perf_counter() - tq)
                 tq = time.perf_counter()
                 reference_execute(windowed, qparts.flows.scan(),
                                   qparts.flows.dicts)
-                best_bw = min(best_bw, time.perf_counter() - tq)
+                t_bw.append(time.perf_counter() - tq)
+            best_qw, best_bw = min(t_qw), min(t_bw)
+            leg_stats["query_pruned_window"] = _leg_stats(t_qw)
             if best_qw > 0:
                 query_bench["query_pruned_window_speedup"] = round(
                     best_bw / best_qw, 1)
@@ -1344,6 +1370,95 @@ def run_benchmarks() -> dict:
                 assert out["cache"] == "hit"
             query_bench["query_cache_hit_ms"] = round(
                 sorted(hits)[len(hits) // 2] * 1e3, 3)
+            leg_stats["query_cache_hit"] = _leg_stats(hits)
+
+            # Sort-ordered parts + skip indexes (PR 12): a SELECTIVE
+            # NON-TIME predicate (one tail destinationIP out of tens
+            # of thousands) under a window covering the whole store —
+            # the sparse primary index (destination-leading sort key)
+            # prunes to a single granule — vs the identical rows in
+            # unsorted v1 parts, which must scan everything in the
+            # window (the pre-PR-12 behavior, reachable via
+            # sort_key=""). Parity (sorted engine == unsorted engine
+            # == pure-numpy reference) gates the timed windows;
+            # ROADMAP item 2 targets >= 10x on this leg. Store size
+            # matters here: the unsorted side scales linearly with
+            # retention while the indexed side stays at per-query
+            # fixed cost + one granule, so the leg uses a 1.2M-row
+            # store (the earlier legs' 60k rows would mostly measure
+            # the shared per-query overhead).
+            sel_series = 2000 if fastq else 24000
+            sel_points = 25 if fastq else 50
+            sel_base = generate_flows(SynthConfig(
+                n_series=sel_series, points_per_series=sel_points))
+            db_sorted = _QDb(engine="parts", parts_config={
+                "sort_key": "destinationIP,sourceIP,timeInserted",
+                "granule_rows": 512,
+                "memtable_rows": 1 << 22})
+            db_unsorted = _QDb(engine="parts", parts_config={
+                "sort_key": "",
+                "memtable_rows": 1 << 22})
+            for d in (db_sorted, db_unsorted):
+                d.insert_flows(sel_base)
+            db_sorted.flows.seal()
+            db_unsorted.flows.seal()
+            n_sel = len(db_sorted.flows)
+            # the least frequent destination, straight from the synth
+            # batch (a table scan here would decode 1.2M rows just to
+            # pick the filter value) — "selective" must mean a tail
+            # value, not the synth mix's heavy hitter
+            import numpy as _np
+            sel_codes, sel_counts = _np.unique(
+                _np.asarray(sel_base["destinationIP"]),
+                return_counts=True)
+            dst = sel_base.dicts["destinationIP"].decode_one(
+                int(sel_codes[_np.argmin(sel_counts)]))
+            selective = parse_plan({
+                "groupBy": "sourceIP",
+                "aggregates": ["sum:octetDeltaCount", "count"],
+                "start": int(sel_base["flowStartSeconds"].min()),
+                "end": int(sel_base["flowEndSeconds"].max()) + 1,
+                "filters": [{"column": "destinationIP", "op": "eq",
+                             "value": dst}],
+                "k": 0})
+            eng_s = QueryEngine(db_sorted)
+            eng_u = QueryEngine(db_unsorted)
+            rs = eng_s.execute(selective, use_cache=False)
+            ru = eng_u.execute(selective, use_cache=False)
+            rref_s, gref_s, _ = reference_execute(
+                selective, db_unsorted.flows.scan(),
+                db_unsorted.flows.dicts)
+            if not (rs["rows"] == ru["rows"] == rref_s
+                    and rs["groupCount"] == gref_s):
+                query_parity_ok = False
+                print("selective-predicate parity: MISMATCH",
+                      file=sys.stderr)
+            else:
+                sel_iters = 2 if fastq else 7
+                t_sorted: list = []
+                t_scan: list = []
+                for _ in range(sel_iters):
+                    tq = time.perf_counter()
+                    eng_s.execute(selective, use_cache=False)
+                    t_sorted.append(time.perf_counter() - tq)
+                    tq = time.perf_counter()
+                    eng_u.execute(selective, use_cache=False)
+                    t_scan.append(time.perf_counter() - tq)
+                best_s, best_u = min(t_sorted), min(t_scan)
+                leg_stats["query_selective_predicate"] = \
+                    _leg_stats(t_sorted)
+                leg_stats["query_selective_scan"] = \
+                    _leg_stats(t_scan)
+                query_bench[
+                    "query_selective_predicate_rows_per_sec"] = \
+                    round(n_sel / best_s)
+                query_bench["query_selective_scan_rows_per_sec"] = \
+                    round(n_sel / best_u)
+                query_bench["query_selective_predicate_speedup"] = \
+                    round(best_u / best_s, 1)
+                query_bench["query_selective_granules_skipped"] = \
+                    int(rs.get("granulesSkipped") or 0)
+
             print("query engine: " + ", ".join(
                 f"{k.replace('query_', '')} {v:,}"
                 if isinstance(v, (int, float)) else f"{k} {v}"
@@ -1994,6 +2109,8 @@ def run_benchmarks() -> dict:
         result["query_parity_ok"] = query_parity_ok
     if query_bench:
         result.update(query_bench)
+    if leg_stats:
+        result["leg_stats"] = leg_stats
     if overload:
         result.update(overload)
     if cluster_bench:

@@ -1,0 +1,591 @@
+"""Sort-ordered parts, sparse primary indexes, per-granule skip
+indexes (store/parts.py format v2 + query/engine.py granule pruning).
+
+The contracts under test:
+
+  * ROW-ID: sorting is invisible outside the part — `scan()` /
+    `select()` un-permute through the part's rowid column, so the
+    PR-7 byte-identical flat parity and positional delete masks hold
+    unchanged (the randomized oracle ALSO compares order-insensitively
+    per the PR-12 acceptance criteria: the weaker contract any sorted
+    engine must meet, asserted alongside the stronger one this
+    implementation keeps).
+  * K-WAY MERGE: a run of sorted parts merges by streaming merge of
+    the sort-key columns (already-ordered runs concatenate), and the
+    result is bit-identical to the concat+rebuild it replaces.
+  * GRANULE PRUNING: for any predicate threshold — including exact
+    zone-map boundaries — the engine's answer matches the pure-numpy
+    reference, with every granule accounted scanned or skipped.
+  * FORMAT VERSIONING: pre-PR-12 v1 (unsorted) parts load lazily,
+    are scanned (never granule-pruned), and background maintenance
+    upgrades them to sorted+indexed v2 in place; v2 snapshots load
+    into a sorting-disabled table (both cross-version directions).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from theia_tpu.data.synth import SynthConfig, generate_flows
+from theia_tpu.query import QueryEngine, parse_plan, reference_execute
+from theia_tpu.query import kernels as qkernels
+from theia_tpu.schema import FLOW_SCHEMA
+from theia_tpu.store import FlowDatabase, PartTable
+from theia_tpu.store.parts import (PART_FORMAT_SORTED,
+                                   PART_FORMAT_UNSORTED,
+                                   kway_merge_order, read_part_file)
+from theia_tpu.store.wal import ROWID_COLUMN
+
+pytestmark = pytest.mark.parts
+
+SORT_KEY = "timeInserted,destinationIP,sourceIP"
+
+
+def _batch(n_series=20, points=10, seed=0, shift=0):
+    b = generate_flows(SynthConfig(n_series=n_series,
+                                   points_per_series=points,
+                                   seed=seed))
+    if shift:
+        for col in ("timeInserted", "flowStartSeconds",
+                    "flowEndSeconds"):
+            b.columns[col] = b[col] + shift
+    return b
+
+
+def _pair(tmp_path=None, memtable_rows=128, ttl_seconds=None, **cfg):
+    parts_cfg = {"memtable_rows": memtable_rows, **cfg}
+    flat = FlowDatabase(engine="flat", ttl_seconds=ttl_seconds)
+    parts = FlowDatabase(
+        engine="parts", ttl_seconds=ttl_seconds,
+        parts_dir=str(tmp_path / "parts") if tmp_path else None,
+        parts_config=parts_cfg)
+    return flat, parts
+
+
+def assert_batches_equal(a, b, schema=FLOW_SCHEMA):
+    assert len(a) == len(b)
+    for c in schema:
+        if c.is_string:
+            np.testing.assert_array_equal(
+                a.strings(c.name), b.strings(c.name), err_msg=c.name)
+        np.testing.assert_array_equal(a[c.name], b[c.name],
+                                      err_msg=c.name)
+
+
+def assert_rows_equal_unordered(a, b, schema=FLOW_SCHEMA):
+    """Order-insensitive bit-parity on rows: same multiset of rows,
+    any order. Both sides saw identical inserts in identical order,
+    so dictionary codes agree and one lexsort over all columns
+    canonicalizes each side."""
+    assert len(a) == len(b)
+    if not len(a):
+        return
+    names = [c.name for c in schema]
+    oa = np.lexsort(tuple(np.asarray(a[n]) for n in reversed(names)))
+    ob = np.lexsort(tuple(np.asarray(b[n]) for n in reversed(names)))
+    for c in schema:
+        np.testing.assert_array_equal(
+            np.asarray(a[c.name])[oa], np.asarray(b[c.name])[ob],
+            err_msg=c.name)
+
+
+def _sorted_parts(db):
+    with db.flows._lock:
+        return [p for p in db.flows._parts
+                if p.fmt >= PART_FORMAT_SORTED]
+
+
+# -- the rowid contract ---------------------------------------------------
+
+
+def test_sealed_parts_are_sorted_v2_with_rowid(tmp_path):
+    flat, parts = _pair(tmp_path, sort_key=SORT_KEY)
+    b = _batch(n_series=40, seed=3)
+    # shuffle so insertion order genuinely differs from sort order
+    rng = np.random.default_rng(0)
+    perm = rng.permutation(len(b))
+    b = b.take(perm)
+    flat.insert_flows(b)
+    parts.insert_flows(b)
+    parts.flows.seal()
+    ps = _sorted_parts(parts)
+    assert ps, "seal with a sort key must produce format-v2 parts"
+    for p in ps:
+        assert p.rowid is not None and p.indexes is not None
+        # chunk order is the sort order: the leading key column's
+        # decoded values are non-decreasing
+        t = p.chunks["timeInserted"].decode()
+        assert (np.diff(t) >= 0).all()
+        # the rowid column rides the part FILE as an ordinary column
+        raw = read_part_file(p.path)
+        assert ROWID_COLUMN in raw.columns
+    # ...and is invisible outside the part: byte-identical flat
+    # parity (decode un-permutes through the rowid)
+    assert_batches_equal(flat.flows.scan(), parts.flows.scan())
+    st = parts.flows.parts_stats()
+    assert st["sorted"] == st["count"] >= 1
+    assert st["indexedParts"] >= 1 and st["granules"] >= 1
+
+
+def test_positional_delete_resolves_through_rowid(tmp_path):
+    flat, parts = _pair(tmp_path, sort_key=SORT_KEY)
+    b = _batch(n_series=30, seed=5)
+    b = b.take(np.random.default_rng(1).permutation(len(b)))
+    flat.insert_flows(b)
+    parts.insert_flows(b)
+    parts.flows.seal()
+    n = len(flat.flows)
+    mask = np.zeros(n, bool)
+    mask[::3] = True
+    assert flat.flows.delete_where(mask.copy()) == \
+        parts.flows.delete_where(mask.copy())
+    assert_batches_equal(flat.flows.scan(), parts.flows.scan())
+    # the rewritten survivors are still sorted v2 parts
+    assert _sorted_parts(parts)
+
+
+def test_randomized_sorted_oracle_deletes_ttl_demotion_coldmerge(
+        tmp_path):
+    """The PR-7 randomized oracle extended to sorted parts: inserts,
+    boundary deletes, id deletes, TTL, demotion to the cold tier, and
+    hot/cold maintenance merges (the k-way path), with order-
+    insensitive row parity asserted at every step — and the stronger
+    byte-identical parity this implementation keeps via the rowid."""
+    rng = np.random.default_rng(17)
+    flat, parts = _pair(tmp_path, memtable_rows=97,
+                        ttl_seconds=3600 * 48, sort_key=SORT_KEY,
+                        granule_rows=64, part_rows=4096)
+    for step in range(16):
+        op = rng.integers(0, 6)
+        if op <= 1:
+            b = _batch(n_series=int(rng.integers(5, 30)),
+                       seed=int(rng.integers(0, 50)),
+                       shift=int(rng.integers(0, 4)) * 3600)
+            b = b.take(rng.permutation(len(b)))
+            now = int(max(b["timeInserted"].max(),
+                          (flat.flows.min_value() or 0)))
+            flat.insert_flows(b, now=now)
+            parts.insert_flows(b, now=now)
+        elif op == 2 and len(flat.flows):
+            t = np.asarray(flat.flows.scan()["timeInserted"])
+            boundary = int(np.quantile(t, float(rng.random())))
+            assert flat.delete_flows_older_than(boundary) == \
+                parts.delete_flows_older_than(boundary)
+        elif op == 3 and len(flat.flows):
+            ips = flat.flows.scan().strings("sourceIP")
+            pick = list(np.unique(ips[:8])) + ["10.99.99.99"]
+            assert flat.flows.delete_ids(pick, column="sourceIP") == \
+                parts.flows.delete_ids(pick, column="sourceIP")
+        elif op == 4:
+            parts.flows.seal()
+            parts.demote_cold(parts.flows.nbytes // 2)
+        else:
+            parts.maintenance_tick()
+        assert_rows_equal_unordered(flat.flows.scan(),
+                                    parts.flows.scan())
+        assert_batches_equal(flat.flows.scan(), parts.flows.scan())
+        if len(flat.flows):
+            t = np.asarray(flat.flows.scan()["flowStartSeconds"])
+            lo, mid = int(t.min()), (int(t.min()) + int(t.max())) // 2
+            assert_rows_equal_unordered(
+                flat.flows.select(start_time=lo, end_time=mid),
+                parts.flows.select(start_time=lo, end_time=mid))
+    st = parts.flows.parts_stats()
+    assert st["sorted"] > 0
+
+
+def test_inconsistent_resident_state_falls_back_to_file(tmp_path):
+    """A lock-free reader can catch a v2 part mid-transition (lazy
+    promotion sets rowid before chunks; demotion clears chunks before
+    rowid) and observe chunks WITHOUT a permutation. _resident_pair
+    must repair or fall back to the file — never return sorted rows
+    as insertion order."""
+    flat, parts = _pair(tmp_path, sort_key=SORT_KEY)
+    b = _batch(seed=6)
+    b = b.take(np.random.default_rng(3).permutation(len(b)))
+    flat.insert_flows(b)
+    parts.insert_flows(b)
+    parts.flows.seal()
+    [part] = _sorted_parts(parts)
+    assert part.chunks is not None
+    # simulate the torn observation: chunks resident, rowid gone
+    part.rowid = None
+    chunks, rowid = parts.flows._resident_pair(part)
+    assert chunks is None   # repair failed → file path mandated
+    # the decode self-heals through the file (and re-promotes),
+    # still answering in insertion order
+    assert_batches_equal(flat.flows.scan(), parts.flows.scan())
+    assert part.rowid is not None   # promotion restored the state
+
+
+# -- k-way merge ----------------------------------------------------------
+
+
+def test_kway_merge_order_unit():
+    # already globally ordered runs: merge is a concat (None)
+    a = [np.array([1, 2, 3]), np.array([10, 20, 30])]
+    b = [np.array([4, 5]), np.array([1, 2])]
+    assert kway_merge_order([a, b]) is None
+    # overlapping runs: order == stable lexsort of the concatenation
+    c = [np.array([2, 6]), np.array([7, 8])]
+    got = kway_merge_order([a, c])
+    keys0 = np.concatenate([a[0], c[0]])
+    keys1 = np.concatenate([a[1], c[1]])
+    want = np.lexsort((keys1, keys0))
+    np.testing.assert_array_equal(got, want)
+    # degenerate: one run / empty runs need no order
+    assert kway_merge_order([a]) is None
+    assert kway_merge_order([a, [np.array([], np.int64),
+                                 np.array([], np.int64)]]) is None
+
+
+def test_kway_merge_equals_concat_and_stays_sorted(tmp_path):
+    """Merging overlapping sorted runs through maintenance must (a)
+    leave the decoded table bit-identical to before (the k-way path
+    is concat+sort-equivalent by stability), (b) produce a sorted v2
+    part, (c) actually merge."""
+    flat, parts = _pair(tmp_path, memtable_rows=64, part_rows=100000,
+                        sort_key=SORT_KEY, granule_rows=32)
+    rng = np.random.default_rng(9)
+    # same time window in every batch → every seal overlaps in key
+    # space, so the merge genuinely interleaves runs
+    for i in range(6):
+        b = _batch(n_series=10, seed=i)
+        b = b.take(rng.permutation(len(b)))
+        flat.insert_flows(b)
+        parts.insert_flows(b)
+    parts.flows.seal()
+    before = parts.flows.parts_stats()["count"]
+    assert before > 1
+    merges = parts.maintenance_tick()
+    st = parts.flows.parts_stats()
+    assert merges >= 1 and st["count"] < before
+    assert st["sorted"] == st["count"]
+    for p in _sorted_parts(parts):
+        t = p.chunks["timeInserted"].decode()
+        assert (np.diff(t) >= 0).all()
+    assert_batches_equal(flat.flows.scan(), parts.flows.scan())
+
+
+# -- granule pruning correctness ------------------------------------------
+
+
+def _query_pair(tmp_path, **cfg):
+    flat, parts = _pair(tmp_path, memtable_rows=1 << 20, **cfg)
+    b = _batch(n_series=60, points=12, seed=4)
+    b = b.take(np.random.default_rng(2).permutation(len(b)))
+    flat.insert_flows(b)
+    parts.insert_flows(b)
+    parts.flows.seal()
+    return flat, parts
+
+
+def _assert_plan_parity(plan, flat, parts):
+    rp = QueryEngine(parts).execute(plan, use_cache=False)
+    rows_ref, groups_ref, _ = reference_execute(
+        plan, flat.flows.scan(), flat.flows.dicts)
+    assert rp["rows"] == rows_ref
+    assert rp["groupCount"] == groups_ref
+    return rp
+
+
+def test_granule_pruning_numeric_boundary_sweep(tmp_path):
+    """Sweep every granule's zone-map boundary values (and ±1) for
+    every comparison op on a numeric column with NO part-level
+    min/max metadata — pruning decisions come entirely from the
+    granule zone maps, and every threshold must answer exactly like
+    the reference. Granule accounting must balance at every probe."""
+    flat, parts = _query_pair(tmp_path,
+                              sort_key="octetDeltaCount,sourceIP",
+                              granule_rows=64)
+    [part] = _sorted_parts(parts)
+    idx = part.indexes
+    n_gran = idx.n_granules
+    assert n_gran >= 4
+    mins, maxs = idx.zones["octetDeltaCount"]
+    thresholds = sorted({int(v) + d
+                         for v in np.concatenate([mins, maxs])
+                         for d in (-1, 0, 1)})
+    # bound the sweep: boundaries of first/mid/last granules plus
+    # global extremes cover the interesting cases
+    probe = thresholds[:6] + thresholds[-6:] + \
+        thresholds[len(thresholds) // 2 - 3:len(thresholds) // 2 + 3]
+    for op in ("ge", "gt", "le", "lt", "eq", "ne"):
+        for v in probe:
+            plan = parse_plan({
+                "groupBy": "destinationIP", "aggregates": ["count"],
+                "filters": [{"column": "octetDeltaCount", "op": op,
+                             "value": int(v)}]})
+            rp = _assert_plan_parity(plan, flat, parts)
+            if rp["partsScanned"]:
+                assert rp["granulesScanned"] + \
+                    rp["granulesSkipped"] == n_gran, (op, v)
+            else:   # every granule proved empty → pruned wholesale
+                assert rp["granulesSkipped"] == n_gran, (op, v)
+    # in-list straddling two distant zones
+    lo, hi = int(mins[0]), int(maxs[-1])
+    plan = parse_plan({
+        "groupBy": "destinationIP", "aggregates": ["count"],
+        "filters": [{"column": "octetDeltaCount", "op": "in",
+                     "value": [lo, hi]}]})
+    _assert_plan_parity(plan, flat, parts)
+
+
+def test_granule_pruning_string_set_and_pk(tmp_path):
+    """String predicates: the sparse primary index (destination-
+    leading sort key → `pk:` reason) and the per-granule set indexes
+    (`skip_set:` on a non-key column) both prune, answers stay
+    bit-identical, and an unknown value skips everything."""
+    flat, parts = _query_pair(
+        tmp_path, sort_key="destinationIP,sourceIP,timeInserted",
+        granule_rows=32)
+    [part] = _sorted_parts(parts)
+    n_gran = part.indexes.n_granules
+    dsts = np.unique(flat.flows.scan().strings("destinationIP"))
+    plan = parse_plan({
+        "groupBy": "sourceIP",
+        "aggregates": ["sum:octetDeltaCount", "count"],
+        "filters": [{"column": "destinationIP", "op": "eq",
+                     "value": str(dsts[0])}]})
+    rp = QueryEngine(parts).execute(plan, use_cache=False,
+                                    explain=True)
+    rows_ref, groups_ref, _ = reference_execute(
+        plan, flat.flows.scan(), flat.flows.dicts)
+    assert rp["rows"] == rows_ref and rp["groupCount"] == groups_ref
+    assert rp["granulesSkipped"] > 0
+    # the EXPLAIN profile narrates the pk prune
+    scanned = [e for e in rp["profile"]["parts"] if "granules" in e]
+    assert scanned
+    reasons = {}
+    for e in scanned:
+        for k, v in (e["granules"].get("reasons") or {}).items():
+            reasons[k] = reasons.get(k, 0) + v
+    assert any(k.startswith("pk:destinationIP") for k in reasons)
+    # a non-key string column exercises the set index
+    pods = np.unique(flat.flows.scan().strings("sourcePodName"))
+    plan2 = parse_plan({
+        "groupBy": "destinationIP", "aggregates": ["count"],
+        "filters": [{"column": "sourcePodName", "op": "in",
+                     "value": [str(pods[0]), str(pods[-1])]}]})
+    _assert_plan_parity(plan2, flat, parts)
+    # unknown value: every granule (and the part) proves empty
+    plan3 = parse_plan({
+        "groupBy": "sourceIP", "aggregates": ["count"],
+        "filters": [{"column": "destinationIP", "op": "eq",
+                     "value": "10.255.255.254"}]})
+    rp3 = _assert_plan_parity(plan3, flat, parts)
+    assert rp3["groupCount"] == 0
+    assert rp3["granulesSkipped"] + rp3["granulesScanned"] in \
+        (0, n_gran)
+
+
+def test_granule_pruning_survives_demotion(tmp_path):
+    """Indexes stay resident when chunks spill: a selective query on
+    a demoted part still skips granules, answers match, and the part
+    stays cold (no promotion)."""
+    flat, parts = _query_pair(
+        tmp_path, sort_key="destinationIP,sourceIP,timeInserted",
+        granule_rows=32)
+    parts.demote_cold(0)   # spill everything
+    [part] = _sorted_parts(parts)
+    assert part.tier == "cold" and part.chunks is None
+    assert part.rowid is None          # spilled with the chunks
+    assert part.indexes is not None    # the pruning substrate stays
+    dsts = np.unique(flat.flows.scan().strings("destinationIP"))
+    plan = parse_plan({
+        "groupBy": "sourceIP", "aggregates": ["count"],
+        "filters": [{"column": "destinationIP", "op": "eq",
+                     "value": str(dsts[-1])}]})
+    rp = _assert_plan_parity(plan, flat, parts)
+    assert rp["granulesSkipped"] > 0
+    assert part.tier == "cold" and part.chunks is None
+
+
+def test_groupby_sort_key_prefix_fast_path_parity(tmp_path):
+    """groupBy == a sort-key prefix takes the contiguous-run kernel
+    path (no lexsort); output must be bit-identical to the reference
+    for 1- and 2-column prefixes, with and without filters."""
+    flat, parts = _query_pair(
+        tmp_path, sort_key="destinationIP,sourceIP,timeInserted",
+        granule_rows=64)
+    for doc in (
+            {"groupBy": "destinationIP",
+             "aggregates": ["sum:octetDeltaCount", "count"]},
+            {"groupBy": ["destinationIP", "sourceIP"],
+             "aggregates": ["count", "max:octetDeltaCount"]},
+            {"groupBy": "destinationIP", "aggregates": ["count"],
+             "filters": [{"column": "protocolIdentifier", "op": "ge",
+                          "value": 6}]},
+            # NOT a prefix → the regular lexsort path, same answer
+            {"groupBy": "sourceIP", "aggregates": ["count"]}):
+        _assert_plan_parity(parse_plan(doc), flat, parts)
+
+
+def test_kernel_presorted_flag_bit_parity():
+    rng = np.random.default_rng(11)
+    keys = np.sort(rng.integers(0, 50, size=(4000, 2)), axis=0)
+    vals = {"v": rng.integers(0, 10**9, size=4000)}
+    specs = [("s", "sum", "v"), ("c", "count", None),
+             ("m", "min", "v")]
+    u1, a1 = qkernels.aggregate(keys, dict(vals), specs,
+                                presorted=True)
+    u2, a2 = qkernels.aggregate(keys, dict(vals), specs,
+                                presorted=False)
+    np.testing.assert_array_equal(u1, u2)
+    for label in ("s", "c", "m"):
+        np.testing.assert_array_equal(a1[label], a2[label])
+
+
+# -- format versioning / cross-version loads ------------------------------
+
+
+def test_v1_store_loads_sorted_world_then_upgrades(tmp_path):
+    """Forward direction: a pre-PR-12 (unsorted) store loads into a
+    sort-keyed table — v1 parts adopt lazily, are scanned (never
+    granule-pruned), answer queries identically, and background
+    maintenance upgrades them to sorted+indexed v2 in place."""
+    d = str(tmp_path)
+    # one big memtable → ONE v1 part: no adjacent-small-parts merge
+    # run forms, so conversion must come from the explicit upgrade
+    # pass (merges also upgrade, but that's the other path)
+    old = FlowDatabase(engine="parts", parts_dir=d + "/parts",
+                       parts_config={"memtable_rows": 1 << 20,
+                                     "sort_key": ""})
+    for i in range(3):
+        old.insert_flows(_batch(seed=i))
+    old.flows.seal()
+    assert old.flows.parts_stats()["sorted"] == 0
+    old.save(d + "/db.npz")
+
+    db2 = FlowDatabase.load(d + "/db.npz", parts_config={
+        "memtable_rows": 64, "sort_key": SORT_KEY,
+        "granule_rows": 64})
+    assert isinstance(db2.flows, PartTable)
+    with db2.flows._lock:
+        fmts = [p.fmt for p in db2.flows._parts]
+    assert fmts and all(f == PART_FORMAT_UNSORTED for f in fmts)
+    assert_batches_equal(old.flows.scan(), db2.flows.scan())
+    # a query scans v1 parts — no granule accounting, same answer
+    plan = parse_plan({"groupBy": "destinationIP",
+                       "aggregates": ["count"]})
+    rp = QueryEngine(db2).execute(plan, use_cache=False)
+    rows_ref, groups_ref, _ = reference_execute(
+        plan, old.flows.scan(), old.flows.dicts)
+    assert rp["rows"] == rows_ref
+    assert rp["granulesScanned"] == rp["granulesSkipped"] == 0
+    # maintenance upgrades v1 → v2 (bounded per pass, so tick until
+    # converged), parity intact, indexes now in place
+    for _ in range(8):
+        db2.maintenance_tick()
+        st = db2.flows.parts_stats()
+        if st["sorted"] == st["count"]:
+            break
+    st = db2.flows.parts_stats()
+    assert st["sorted"] == st["count"] >= 1
+    assert st["upgraded"] >= 1 and st["indexedParts"] >= 1
+    assert_batches_equal(old.flows.scan(), db2.flows.scan())
+    rp2 = QueryEngine(db2).execute(plan, use_cache=False)
+    assert rp2["rows"] == rows_ref
+    assert rp2["granulesScanned"] > 0
+
+
+def test_v2_store_loads_with_sorting_disabled(tmp_path):
+    """Backward direction: a sorted+indexed snapshot loads into a
+    table with sorting DISABLED — v2 parts keep decoding through
+    their rowid (the manifest stamps fmt + sortKey per part), new
+    seals are v1, and parity holds across a mixed-format store."""
+    d = str(tmp_path)
+    new = FlowDatabase(engine="parts", parts_dir=d + "/parts",
+                       parts_config={"memtable_rows": 64,
+                                     "sort_key": SORT_KEY,
+                                     "granule_rows": 64})
+    flat = FlowDatabase(engine="flat")
+    for i in range(3):
+        b = _batch(seed=i)
+        new.insert_flows(b)
+        flat.insert_flows(b)
+    new.flows.seal()
+    assert new.flows.parts_stats()["sorted"] >= 1
+    new.save(d + "/db.npz")
+
+    db2 = FlowDatabase.load(d + "/db.npz", parts_config={
+        "memtable_rows": 64, "sort_key": ""})
+    with db2.flows._lock:
+        fmts = [p.fmt for p in db2.flows._parts]
+    assert fmts and all(f == PART_FORMAT_SORTED for f in fmts)
+    assert_batches_equal(flat.flows.scan(), db2.flows.scan())
+    # mixed-format store: new rows seal as v1 beside the loaded v2
+    b = _batch(seed=9)
+    db2.insert_flows(b)
+    flat.insert_flows(b)
+    db2.flows.seal()
+    fmt_set = {p.fmt for p in db2.flows._parts}
+    assert fmt_set == {PART_FORMAT_UNSORTED, PART_FORMAT_SORTED}
+    assert_batches_equal(flat.flows.scan(), db2.flows.scan())
+    # merges across the format mix fall back to concat+rebuild (v1
+    # here — no sort key) and stay parity-clean
+    for _ in range(4):
+        db2.maintenance_tick()
+    assert_batches_equal(flat.flows.scan(), db2.flows.scan())
+
+
+def test_debug_parts_endpoint_and_auth(tmp_path, monkeypatch):
+    """GET /debug/parts serves the per-part inventory (`theia parts`
+    backing), token-gated like the other /debug surfaces."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from theia_tpu.manager import TheiaManagerServer
+    monkeypatch.setenv("THEIA_RETENTION_INTERVAL", "0")
+    _, parts = _pair(tmp_path, sort_key=SORT_KEY, granule_rows=64)
+    parts.insert_flows(_batch(seed=1))
+    parts.flows.seal()
+    srv = TheiaManagerServer(parts, port=0, workers=1,
+                             auth_token="sekrit")
+    srv.start_background()
+    try:
+        url = f"http://127.0.0.1:{srv.port}/debug/parts?limit=4"
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(url, timeout=10)
+        assert e.value.code == 401
+        req = urllib.request.Request(
+            url, headers={"Authorization": "Bearer sekrit"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            doc = json.loads(r.read())
+        assert doc["engine"] == "parts"
+        [t] = [t for t in doc["tables"] if t["table"] == "flows"]
+        st = t["stats"]
+        assert st["sorted"] >= 1 and st["granules"] >= 1
+        assert st["sortKey"] == SORT_KEY.split(",")
+        entry = t["parts"][0]
+        assert entry["fmt"] == PART_FORMAT_SORTED
+        assert entry["granules"] >= 1 and entry["indexBytes"] > 0
+        assert len(t["parts"]) <= 4
+    finally:
+        srv.shutdown()
+
+
+def test_part_body_replay_drops_rowid_on_adoption(tmp_path):
+    """Cluster resync ships COLD part files verbatim as ingest
+    records: the __rowid__ column a v2 part body carries must vanish
+    at schema-driven adoption, leaving the part's rows (in sort
+    order — resync is order-insensitive by the same oracle
+    contract)."""
+    _, parts = _pair(tmp_path, sort_key=SORT_KEY)
+    b = _batch(seed=8)
+    parts.insert_flows(b)
+    parts.flows.seal()
+    parts.demote_cold(0)   # cold parts ship their file body verbatim
+    recs = parts.flows.export_encoded_records()
+    assert recs
+    fresh = FlowDatabase(engine="flat")
+    from theia_tpu.store.wal import decode_record_body
+    for rec in recs:
+        _table, batch = decode_record_body(rec)
+        assert ROWID_COLUMN in batch.columns
+        fresh.insert_flows(batch)
+    assert ROWID_COLUMN not in fresh.flows.scan().columns
+    assert_rows_equal_unordered(parts.flows.scan(),
+                                fresh.flows.scan())

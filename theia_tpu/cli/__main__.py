@@ -1197,6 +1197,63 @@ def top(args) -> None:
         pass
 
 
+def parts_cmd(args) -> None:
+    """`theia parts` — the storage engine at inspection depth: the
+    `theia top` parts header expanded to per-table sort-key / granule
+    / index stats and a bounded per-part inventory (token-gated
+    GET /debug/parts)."""
+    doc = _request(args.manager_addr, "GET",
+                   f"/debug/parts?limit={args.limit}")
+    if args.json:
+        print(json.dumps(doc, indent=2))
+        return
+    if doc.get("engine") != "parts" or not doc.get("tables"):
+        print("store engine: flat (no parts — set "
+              "THEIA_STORE_ENGINE=parts)")
+        return
+
+    def kb(n) -> str:
+        return f"{(n or 0) / 1e3:,.1f}K"
+
+    for t in doc["tables"]:
+        s = t.get("stats") or {}
+        shard = f" [shard {t['shard']}]" if "shard" in t else ""
+        print(f"table {t.get('table')}{shard}: "
+              f"{s.get('count', 0):,} parts "
+              f"({s.get('hot', 0):,} hot / {s.get('cold', 0):,} cold, "
+              f"{s.get('sorted', 0):,} sorted v2), "
+              f"{s.get('rows', 0):,} rows "
+              f"+ {s.get('memtableRows', 0):,} memtable")
+        key = ",".join(s.get("sortKey") or ()) or "(none — unsorted)"
+        print(f"  sort key: {key}; granule {s.get('granuleRows', 0):,}"
+              f" rows — {s.get('indexedParts', 0):,} indexed parts, "
+              f"{s.get('granules', 0):,} granules, "
+              f"index {kb(s.get('indexBytes'))}B resident")
+        print(f"  lifetime: {s.get('sealed', 0):,} sealed, "
+              f"{s.get('merges', 0):,} merges "
+              f"({s.get('coldMerges', 0):,} cold), "
+              f"{s.get('demoted', 0):,} demoted, "
+              f"{s.get('upgraded', 0):,} upgraded v1→v2")
+        entries = t.get("parts") or []
+        if not entries:
+            continue
+        rows = [{
+            "UID": e.get("uid", ""),
+            "TIER": e.get("tier", ""),
+            "FMT": f"v{e.get('fmt', 1)}",
+            "ROWS": f"{e.get('rows', 0):,}",
+            "RAM": kb(e.get("residentBytes")),
+            "FILE": kb(e.get("fileBytes")),
+            "GRANULES": e.get("granules", ""),
+            "INDEX": (kb(e.get("indexBytes"))
+                      if "indexBytes" in e else ""),
+            "TIME-RANGE": "..".join(
+                str(v) for v in (e.get("timeRange") or ())),
+        } for e in entries]
+        _print_table(rows, ["UID", "TIER", "FMT", "ROWS", "RAM",
+                            "FILE", "GRANULES", "INDEX", "TIME-RANGE"])
+
+
 def version(args) -> None:
     from .. import __version__
     print(f"theia version: {__version__}")
@@ -1492,6 +1549,18 @@ def build_parser() -> argparse.ArgumentParser:
                                      "a /query result, or a span in "
                                      "/debug/traces")
     tr.set_defaults(fn=trace_cmd)
+
+    pa = sub.add_parser("parts",
+                        help="storage-engine part inventory from the "
+                             "manager's GET /debug/parts: per-table "
+                             "parts, tiers, formats, sort key, and "
+                             "granule/index stats")
+    pa.add_argument("--limit", type=int, default=64,
+                    help="max per-part rows per table (the summary "
+                         "header always covers everything)")
+    pa.add_argument("--json", action="store_true",
+                    help="print the raw /debug/parts document")
+    pa.set_defaults(fn=parts_cmd)
 
     ver = sub.add_parser("version")
     ver.set_defaults(fn=version)

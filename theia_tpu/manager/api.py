@@ -462,6 +462,16 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
             from ..query.explain import SLOW_QUERIES
             self._send_json(SLOW_QUERIES.doc())
             return
+        if parts == ("debug", "parts"):
+            # Storage-engine inspection depth (`theia parts`):
+            # per-table part inventories — tiers, formats, sort key,
+            # index bytes, granule stats, time ranges. Part time
+            # ranges narrate traffic shape and the doc names on-disk
+            # paths, so token-gated like the other /debug surfaces.
+            self._require_auth()
+            limit = int(self._query().get("limit", "256"))
+            self._send_json(self._parts_debug_doc(limit))
+            return
         if parts == ("query",):
             # Aggregation results decode flow identities (IPs, pods) —
             # the /alerts sensitivity class, so the token (when
@@ -595,6 +605,32 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(raw)))
         self.end_headers()
         self.wfile.write(raw)
+
+    def _parts_debug_doc(self, limit: int) -> Dict[str, object]:
+        """GET /debug/parts: the parts engine at inspection depth —
+        the `theia top` parts header expanded to one entry per part.
+        Sharded stores report every shard table; the flat engine
+        answers an empty table list (engine "flat") rather than 404,
+        so the CLI can say "flat engine" instead of guessing."""
+        db = self.controller.db
+        flows = db.flows   # replicated: resolves the active replica
+        tables = (list(flows.tables) if hasattr(flows, "tables")
+                  else [flows])
+        docs = []
+        for i, t in enumerate(tables):
+            ps = getattr(t, "parts_stats", None)
+            if not callable(ps):
+                continue
+            tdoc: Dict[str, object] = {
+                "table": t.name,
+                "stats": ps(),
+                "parts": t.parts_debug_entries(limit),
+            }
+            if len(tables) > 1:
+                tdoc["shard"] = i
+            docs.append(tdoc)
+        return {"engine": "parts" if docs else "flat",
+                "tables": docs}
 
     def _health_doc(self) -> Dict[str, object]:
         """Liveness + degradation surface (no decoded identities, so it

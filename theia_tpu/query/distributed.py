@@ -333,7 +333,8 @@ class ClusterQueryCoordinator:
         # local partial executes on the coordinator thread while the
         # fan-out is in flight (sharing `prof`, so the local store's
         # per-part scanned/pruned detail lands in the profile)
-        stats = {"rowsScanned": 0, "partsScanned": 0, "partsPruned": 0}
+        stats = {"rowsScanned": 0, "partsScanned": 0, "partsPruned": 0,
+                 "granulesScanned": 0, "granulesSkipped": 0}
         results = [self.engine.execute_partial(plan, stats, prof)]
         failed: List[str] = []
         peer_errors: Dict[str, str] = {}
@@ -359,6 +360,10 @@ class ClusterQueryCoordinator:
                     rowsScanned=int(meta.get("rowsScanned") or 0),
                     partsScanned=int(meta.get("partsScanned") or 0),
                     partsPruned=int(meta.get("partsPruned") or 0),
+                    granulesScanned=int(
+                        meta.get("granulesScanned") or 0),
+                    granulesSkipped=int(
+                        meta.get("granulesSkipped") or 0),
                     fingerprint=meta.get("fingerprint"))
             results.append((keys, aggs))
         missing = sorted(down + failed)
@@ -401,6 +406,8 @@ class ClusterQueryCoordinator:
             "rowsScanned": stats["rowsScanned"],
             "partsScanned": stats["partsScanned"],
             "partsPruned": stats["partsPruned"],
+            "granulesScanned": stats["granulesScanned"],
+            "granulesSkipped": stats["granulesSkipped"],
             "engine": "cluster",
             "peers": {
                 "total": len(self.cmap.order),
@@ -435,6 +442,8 @@ class ClusterQueryCoordinator:
                 rowsScanned=stats["rowsScanned"],
                 partsScanned=stats["partsScanned"],
                 partsPruned=stats["partsPruned"],
+                granulesScanned=stats["granulesScanned"],
+                granulesSkipped=stats["granulesSkipped"],
                 bytesShipped=bytes_shipped,
             )
             # the matched count (and any per-part detail) covers the
@@ -492,7 +501,8 @@ def serve_partial(engine, plan: QueryPlan,
     carries this node's scan stats (the coordinator sums them into
     the result doc) and its CURRENT store fingerprint."""
     t0 = time.perf_counter()
-    stats = {"rowsScanned": 0, "partsScanned": 0, "partsPruned": 0}
+    stats = {"rowsScanned": 0, "partsScanned": 0, "partsPruned": 0,
+             "granulesScanned": 0, "granulesSkipped": 0}
     keys, aggs = engine.execute_partial(plan, stats)
     _M_PARTIALS_SERVED.inc()
     meta: Dict[str, object] = {"node": node_id, **stats,

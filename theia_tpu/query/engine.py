@@ -9,7 +9,16 @@ materialized copy:
 
   1. **Plan → prune.** Part min/max metadata (the PR-7 pruning
      substrate) drops parts that cannot overlap the time window or a
-     numeric filter's range before any column is touched.
+     numeric filter's range before any column is touched. Inside the
+     surviving SORTED parts (store/parts.py format v2), the same
+     decision repeats at GRANULE granularity from the resident index
+     metadata: the sparse primary index (zone map of the sort-key
+     prefix, ascending because the part is sorted), per-granule
+     min/max zone maps on every column, and bounded set indexes of
+     distinct dictionary codes on string columns. Predicates decide
+     granules BEFORE any row is gathered; only surviving granule row
+     ranges are evaluated (`pk:`/`skip_minmax:`/`skip_set:` reasons
+     in EXPLAIN, theia_query_granules_{scanned,skipped}_total).
   2. **Filters in encoded space.** On a hot part, a numeric predicate
      compares the WIDTH-REDUCED stored array against the rebased
      threshold (`v - base`, clamped: an out-of-range threshold decides
@@ -24,7 +33,12 @@ materialized copy:
      (numerics). Aggregation itself is query/kernels.py — lexsort +
      reduceat, or one jitted `jnp` segment-reduction dispatch
      (`THEIA_QUERY_JAX`, the THEIA_FUSED_PALLAS auto/fallback
-     discipline).
+     discipline). When the plan's groupBy is a PREFIX of the part's
+     sort key, the part's rows are already key-clustered (local
+     indices and width-reduced ints are monotone in the decoded
+     values) and the kernel skips its lexsort entirely — group
+     boundaries come from one adjacent-row comparison over
+     contiguous runs, bit-identical output.
   4. **Parallel per-part execution.** Live parts are striped across a
      bounded pool (`THEIA_QUERY_WORKERS`); each worker folds its
      parts into ONE per-worker partial accumulator, and the partials
@@ -89,6 +103,16 @@ _M_PARTS_PRUNED = _metrics.counter(
     "theia_query_parts_pruned_total",
     "Parts skipped by query min/max + dictionary-code pruning (read "
     "with theia_query_parts_scanned_total for the prune ratio)")
+_M_GRANULES_SCANNED = _metrics.counter(
+    "theia_query_granules_scanned_total",
+    "Index granules evaluated inside sorted parts after granule-level "
+    "skip-index pruning (sorted format-v2 parts only)")
+_M_GRANULES_SKIPPED = _metrics.counter(
+    "theia_query_granules_skipped_total",
+    "Index granules skipped inside sorted parts by the sparse primary "
+    "index and per-granule zone-map/set skip indexes (read with "
+    "theia_query_granules_scanned_total for the intra-part prune "
+    "ratio)")
 _M_CACHE_HITS = _metrics.counter(
     "theia_query_cache_hits_total",
     "Queries answered from the result cache (same normalized plan, "
@@ -143,8 +167,7 @@ class _CompiledFilter:
         chunk = chunks.get(self.column) if chunks is not None else None
         if chunk is None or not hasattr(chunk, "uniq"):
             return False       # cold/lazy: no resident code set
-        return not np.isin(chunk.uniq, self.codes,
-                           assume_unique=True).any()
+        return not _sorted_intersects(self.codes, chunk.uniq)
 
 
 def _minmax_excludes(mm: Tuple[int, int], op: str, value) -> bool:
@@ -166,13 +189,68 @@ def _minmax_excludes(mm: Tuple[int, int], op: str, value) -> bool:
     return False   # ne: metadata can't exclude
 
 
-def _cmp_encoded(chunk, op: str, value: int) -> object:
+def _zone_excludes(mins: np.ndarray, maxs: np.ndarray, op: str,
+                   value) -> np.ndarray:
+    """Vectorized `_minmax_excludes` over per-granule zone maps: a
+    bool array, True where granule g PROVABLY holds no matching row.
+    `ne` proves nothing (a granule whose zone equals the value could
+    still be all-equal — but so could any other)."""
+    if op == "ge":
+        return maxs < value
+    if op == "gt":
+        return maxs <= value
+    if op == "le":
+        return mins > value
+    if op == "lt":
+        return mins >= value
+    if op == "eq":
+        return (value < mins) | (value > maxs)
+    if op == "in":
+        drop = np.ones(len(mins), bool)
+        for v in value:
+            drop &= (v < mins) | (v > maxs)
+        return drop
+    return np.zeros(len(mins), bool)
+
+
+def _sorted_intersects(a: np.ndarray, b: np.ndarray) -> bool:
+    """Any common element between two SORTED unique integer arrays.
+    This runs once per (surviving granule, string filter) — np.isin's
+    dispatch overhead (dtype logic, zeros_like, min/max probing) is
+    ~50us per call at that grain and was the dominant cost of a fully
+    index-pruned query; two searchsorted-style ops are ~2us."""
+    if not len(a) or not len(b):
+        return False
+    if len(a) > len(b):
+        a, b = b, a
+    pos = np.searchsorted(b, a)
+    pos[pos == len(b)] = len(b) - 1
+    return bool((b[pos] == a).any())
+
+
+def _ranges_to_rows(starts: np.ndarray, ends: np.ndarray) -> np.ndarray:
+    """Concatenated `arange(s, e)` for every surviving granule range,
+    in one cumsum pass (no per-granule allocations): an all-ones array
+    with each range's first element patched to jump from the previous
+    range's end."""
+    lens = (ends - starts).astype(np.int64)
+    total = int(lens.sum())
+    out = np.ones(total, np.int64)
+    out[0] = starts[0]
+    cuts = np.cumsum(lens)[:-1]
+    out[cuts] = starts[1:] - ends[:-1] + 1
+    return np.cumsum(out)
+
+
+def _cmp_encoded(chunk, op: str, value: int,
+                 rows: Optional[np.ndarray] = None) -> object:
     """Evaluate `col <op> value` on a width-reduced numeric chunk
     WITHOUT widening: compare the narrow stored array against the
     rebased threshold. Returns a bool array, or True/False when the
     rebased threshold falls outside the stored dtype's range (the
-    whole part decides at once)."""
-    s = chunk.stored
+    whole part decides at once). `rows` restricts the comparison to
+    that row selection (the granule-surviving rows)."""
+    s = chunk.stored if rows is None else chunk.stored[rows]
     if op == "in":
         vals = np.asarray(value, np.int64) - chunk.base
         lo, hi = (np.iinfo(s.dtype).min, np.iinfo(s.dtype).max) \
@@ -392,11 +470,14 @@ class QueryEngine:
         t0 = time.perf_counter()
         tables = self._tables()
         fp = self.fingerprint(tables)
-        key = (plan.normalized(), fp)
         # a disabled cache (THEIA_QUERY_CACHE_BYTES=0) reports "off",
-        # not a permanent 0% hit ratio that reads as a broken cache
+        # not a permanent 0% hit ratio that reads as a broken cache —
+        # and an uncached execution (every /query/partial, every
+        # cache=0 probe) skips the key's plan-JSON normalization
+        # entirely
         caching = use_cache and self.cache.max_bytes > 0
         if caching:
+            key = (plan.normalized(), fp)
             hit = self.cache.lookup(key)
             if hit is not None:
                 _M_CACHE_HITS.inc()
@@ -419,7 +500,8 @@ class QueryEngine:
                 return doc
             _M_CACHE_MISSES.inc()
         prof = QueryProfiler.maybe(explain)
-        stats = {"rowsScanned": 0, "partsScanned": 0, "partsPruned": 0}
+        stats = {"rowsScanned": 0, "partsScanned": 0, "partsPruned": 0,
+                 "granulesScanned": 0, "granulesSkipped": 0}
         t_exec = time.perf_counter()
         keys, aggs = self._partial_for_tables(plan, tables, stats,
                                               prof)
@@ -433,6 +515,8 @@ class QueryEngine:
         _M_ROWS_SCANNED.inc(stats["rowsScanned"])
         _M_PARTS_SCANNED.inc(stats["partsScanned"])
         _M_PARTS_PRUNED.inc(stats["partsPruned"])
+        _M_GRANULES_SCANNED.inc(stats["granulesScanned"])
+        _M_GRANULES_SKIPPED.inc(stats["granulesSkipped"])
         doc = {
             "plan": plan.to_doc(),
             "rows": rows,
@@ -440,6 +524,8 @@ class QueryEngine:
             "rowsScanned": stats["rowsScanned"],
             "partsScanned": stats["partsScanned"],
             "partsPruned": stats["partsPruned"],
+            "granulesScanned": stats["granulesScanned"],
+            "granulesSkipped": stats["granulesSkipped"],
             "engine": ("parts" if any(
                 getattr(t, "_parts", None) is not None
                 for t in tables) else "flat"),
@@ -464,6 +550,8 @@ class QueryEngine:
                 rowsScanned=stats["rowsScanned"],
                 partsScanned=stats["partsScanned"],
                 partsPruned=stats["partsPruned"],
+                granulesScanned=stats["granulesScanned"],
+                granulesSkipped=stats["granulesSkipped"],
             )
             SLOW_QUERIES.observe(plan, doc, prof, profile)
         if explain and profile is not None:
@@ -493,7 +581,10 @@ class QueryEngine:
         merge (query/distributed.py)."""
         if stats is None:
             stats = {"rowsScanned": 0, "partsScanned": 0,
-                     "partsPruned": 0}
+                     "partsPruned": 0, "granulesScanned": 0,
+                     "granulesSkipped": 0}
+        for k in ("granulesScanned", "granulesSkipped"):
+            stats.setdefault(k, 0)
         return self._partial_for_tables(plan, self._tables(), stats,
                                         prof)
 
@@ -540,20 +631,104 @@ class QueryEngine:
                                              table.dicts).sum()))
         return reference_partial(plan, batch, table.dicts), len(batch)
 
+    def _granule_prune(self, plan: QueryPlan, filters, part
+                       ) -> Optional[Tuple[np.ndarray,
+                                           Dict[str, int]]]:
+        """Granule-level skip decisions for one SORTED part from its
+        RESIDENT index metadata only — no chunk or file is touched.
+        Returns (keep bool array over granules, {reason: granules
+        skipped}) or None when the part carries no indexes (format
+        v1, or a lazily-adopted v2 part whose indexes rebuild on
+        promotion — scanned whole, exactly as pre-PR-12).
+
+        Reasons mirror the part-level ones one tier down:
+        `pk:<col>` — the sparse primary index (the zone map of the
+        part's FIRST sort-key column, ascending because the part is
+        sorted, so this is the binary-searchable MergeTree index);
+        `skip_minmax:<col>` — any other column's zone map;
+        `skip_set:<col>` — a string column's per-granule distinct-
+        code set missed every resolved filter code."""
+        idx = part.indexes
+        if idx is None:
+            return None
+        keep = np.ones(idx.n_granules, bool)
+        reasons: Dict[str, int] = {}
+        pk = part.sort_key[0] if part.sort_key else None
+
+        def drop(col: str, excluded: np.ndarray, kind: str) -> None:
+            newly = int((excluded & keep).sum())
+            if newly:
+                label = (f"pk:{col}" if col == pk
+                         else f"{kind}:{col}")
+                reasons[label] = reasons.get(label, 0) + newly
+                np.logical_and(keep, ~excluded, out=keep)
+
+        if plan.start is not None:
+            zm = idx.zones.get(plan.time_column)
+            if zm is not None:
+                drop(plan.time_column, zm[1] < plan.start,
+                     "skip_minmax")
+        if plan.end is not None and keep.any():
+            zm = idx.zones.get(plan.end_column)
+            if zm is not None:
+                drop(plan.end_column, zm[0] >= plan.end,
+                     "skip_minmax")
+        for f in filters:
+            if not keep.any():
+                break
+            if f.op == "ne":
+                continue   # proves nothing at any granularity
+            if f.is_string:
+                if not len(f.codes):
+                    # value(s) absent from the dictionary: no granule
+                    # anywhere can match (cold parts reach here — the
+                    # part-level code check needs resident chunks)
+                    drop(f.column, np.ones(len(keep), bool),
+                         "skip_set")
+                    break
+                zm = idx.zones.get(f.column)
+                if zm is not None:
+                    # zone maps over dictionary codes: f.codes is
+                    # sorted unique, so "any code in [min, max]" is
+                    # two searchsorteds, vectorized over granules
+                    lo = np.searchsorted(f.codes, zm[0], side="left")
+                    hi = np.searchsorted(f.codes, zm[1], side="right")
+                    drop(f.column, hi == lo, "skip_minmax")
+                sets = idx.sets.get(f.column)
+                if sets is not None:
+                    excluded = np.zeros(len(keep), bool)
+                    for g in np.flatnonzero(keep):
+                        s = sets[g]
+                        if s is not None and not _sorted_intersects(
+                                f.codes, s):
+                            excluded[g] = True
+                    drop(f.column, excluded, "skip_set")
+            else:
+                zm = idx.zones.get(f.column)
+                if zm is not None:
+                    drop(f.column, _zone_excludes(zm[0], zm[1],
+                                                  f.op, f.value),
+                         "skip_minmax")
+        return keep, reasons
+
     def _parts_partials(self, plan: QueryPlan, table, stats,
                         prof: Optional[QueryProfiler] = None
                         ) -> Partial:
-        """Parts engine: prune → stripe live parts across the worker
-        pool (each worker folds its stripe into one partial
-        accumulator) → evaluate the memtable via the reference path →
-        merge everything exactly. `prof` (the EXPLAIN profiler)
-        records each part's fate and the prune REASON — the decisions
-        are computed here regardless, so profiling adds bookkeeping,
+        """Parts engine: prune (whole parts from min/max + code sets,
+        then GRANULES inside surviving sorted parts from their skip
+        indexes) → stripe live parts across the worker pool (each
+        worker folds its stripe into one partial accumulator) →
+        evaluate the memtable via the reference path → merge
+        everything exactly. `prof` (the EXPLAIN profiler) records each
+        part's fate, the prune REASON, and the per-part granule
+        scanned/skipped counts with reasons — the decisions are
+        computed here regardless, so profiling adds bookkeeping,
         never work."""
         specs = lower_specs(plan)
         filters = [_CompiledFilter(f, table) for f in plan.filters]
         parts, mem = table._snapshot_refs()
-        live = []
+        #: (part, surviving-row selection or None for all rows)
+        live: List[Tuple[object, Optional[np.ndarray]]] = []
         pruned = 0
         for p in parts:
             reason = None
@@ -576,12 +751,37 @@ class QueryEngine:
                             mm, f.op, f.value):
                         reason = f"range:{f.column}"
                         break
+            rows_sel = None
+            gdetail = None
+            if reason is None:
+                gp = self._granule_prune(plan, filters, p)
+                if gp is not None:
+                    keep, greasons = gp
+                    kept = int(keep.sum())
+                    skipped = len(keep) - kept
+                    stats["granulesScanned"] += kept
+                    stats["granulesSkipped"] += skipped
+                    gdetail = {"scanned": kept, "skipped": skipped}
+                    if greasons:
+                        gdetail["reasons"] = greasons
+                    if kept == 0:
+                        # every granule provably empty — the part
+                        # prunes wholesale, one tier late
+                        reason = "granules"
+                    elif skipped:
+                        idx = p.indexes
+                        rows_sel = _ranges_to_rows(
+                            idx.starts[keep],
+                            idx.granule_ends()[keep])
             if reason is not None:
                 pruned += 1
             else:
-                live.append(p)
+                live.append((p, rows_sel))
+                stats["rowsScanned"] += (
+                    len(rows_sel) if rows_sel is not None else p.rows)
             if prof is not None:
-                prof.add_part(p.uid, p.tier, p.rows, pruned=reason)
+                prof.add_part(p.uid, p.tier, p.rows, pruned=reason,
+                              granules=gdetail)
         partials: List[Partial] = []
         if live:
             stripes = [live[i::self.workers]
@@ -604,7 +804,6 @@ class QueryEngine:
                     prof.memtable_rows += len(b)
         stats["partsScanned"] += len(live)
         stats["partsPruned"] += pruned
-        stats["rowsScanned"] += sum(p.rows for p in live)
         merged = kernels.merge_partials(
             [p for p in partials if p is not None], specs)
         return merged if len(merged[0]) else None
@@ -612,11 +811,12 @@ class QueryEngine:
     def _fold_stripe(self, plan, table, specs, filters,
                      parts: Sequence,
                      prof: Optional[QueryProfiler] = None) -> Partial:
-        """One worker's stripe: evaluate each part, fold the partials
-        into a single per-worker accumulator."""
+        """One worker's stripe of (part, row-selection) pairs:
+        evaluate each part over its granule-surviving rows, fold the
+        partials into a single per-worker accumulator."""
         partials = [self._part_partial(plan, table, specs, filters, p,
-                                       prof)
-                    for p in parts]
+                                       rows_sel, prof)
+                    for p, rows_sel in parts]
         partials = [p for p in partials if p is not None]
         if not partials:
             return None
@@ -625,58 +825,83 @@ class QueryEngine:
     # -- per-part evaluation -----------------------------------------------
 
     def _part_partial(self, plan, table, specs, filters, part,
+                      rows_sel: Optional[np.ndarray] = None,
                       prof: Optional[QueryProfiler] = None
                       ) -> Partial:
         chunks = part.chunks
         if chunks is None:
             if part.tier == "cold":
                 return self._cold_partial(plan, table, specs, part,
-                                          prof)
+                                          rows_sel, prof)
             # lazy-recovery hot part: decode (and promote) once, then
-            # evaluate in decoded space
+            # evaluate in decoded space. rows_sel is normally None
+            # here (a lazy part has no resident indexes when the
+            # selection is computed), but a promotion racing the
+            # planning loop can hand us one — honor it through the
+            # freshly-promoted rowid so the rowsScanned accounting
+            # stays truthful (the decoded batch is insertion-order;
+            # rowid maps the sort-order selection back onto it).
             batch = table._decode_part(part)
+            if rows_sel is not None:
+                rid = part.rowid
+                if rid is not None:
+                    batch = batch.take(
+                        np.asarray(rid, np.int64)[rows_sel])
             return self._decoded_partial(plan, table, specs, batch,
                                          prof)
         return self._encoded_partial(plan, table, specs, filters,
-                                     chunks, part.rows, prof)
+                                     part, chunks, rows_sel, prof)
 
     def _encoded_partial(self, plan, table, specs, filters,
-                         chunks, n_rows: int,
+                         part, chunks,
+                         rows_sel: Optional[np.ndarray] = None,
                          prof: Optional[QueryProfiler] = None
                          ) -> Partial:
         """Hot part, no decode: predicates on width-reduced ints and
         local dictionary indices; group keys aggregate in local code
-        space; only surviving groups widen to global codes."""
+        space; only surviving groups widen to global codes. A non-None
+        `rows_sel` (granule pruning) restricts every column touch to
+        the surviving granules' rows — skipped granules cost nothing,
+        not even the predicate comparison."""
+        n_rows = part.rows if rows_sel is None else len(rows_sel)
+
+        def take(arr: np.ndarray) -> np.ndarray:
+            return arr if rows_sel is None else arr[rows_sel]
+
         mask: object = True
         if plan.start is not None:
             mask = _and_mask(mask, _cmp_encoded(
-                chunks[plan.time_column], "ge", plan.start))
+                chunks[plan.time_column], "ge", plan.start, rows_sel))
         if mask is not False and plan.end is not None:
             mask = _and_mask(mask, _cmp_encoded(
-                chunks[plan.end_column], "lt", plan.end))
+                chunks[plan.end_column], "lt", plan.end, rows_sel))
         for f in filters:
             if mask is False:
                 return None
             chunk = chunks[f.column]
             if f.is_string:
                 # global code set → positions in the part's unique
-                # codes; an empty intersection decides the part
+                # codes (both sorted unique: searchsorted, not a
+                # linear isin over the part's whole code set); an
+                # empty intersection decides the part
                 sel = np.zeros(len(chunk.uniq), bool)
                 if len(f.codes):
-                    sel[np.isin(chunk.uniq, f.codes,
-                                assume_unique=True)] = True
+                    pos = np.searchsorted(chunk.uniq, f.codes)
+                    ok = pos < len(chunk.uniq)
+                    pos = pos[ok]
+                    sel[pos[chunk.uniq[pos] == f.codes[ok]]] = True
                 if f.op == "ne":
                     if not sel.any():
                         continue   # nothing excluded
-                    m = ~sel[chunk.local]
+                    m = ~sel[take(chunk.local)]
                 else:
                     if not sel.any():
                         return None   # eq/in can never match here
-                    m = sel[chunk.local]
+                    m = sel[take(chunk.local)]
                 mask = _and_mask(mask, m)
             else:
-                mask = _and_mask(mask,
-                                 _cmp_encoded(chunk, f.op, f.value))
+                mask = _and_mask(mask, _cmp_encoded(
+                    chunk, f.op, f.value, rows_sel))
         if mask is False:
             return None
         full = mask is True
@@ -688,10 +913,19 @@ class QueryEngine:
             prof.add_matched(int(n_rows if full else mask.sum()))
 
         def masked(arr: np.ndarray) -> np.ndarray:
-            return arr if full else arr[mask]
+            rows = take(arr)
+            return rows if full else rows[mask]
 
         # group keys in LOCAL narrow space; remember how to widen the
-        # survivors
+        # survivors. When the groupBy is a PREFIX of the part's sort
+        # key the rows are already key-clustered (local indices and
+        # width-reduced ints are monotone in the decoded values, and
+        # granule selection/masking preserve row order), so the kernel
+        # can skip its lexsort — boundaries from one adjacent-row
+        # comparison over the contiguous runs.
+        presorted = bool(plan.group_by) and part.sort_key and \
+            tuple(plan.group_by) == \
+            tuple(part.sort_key[:len(plan.group_by)])
         key_cols: List[np.ndarray] = []
         widen: List[Tuple[str, object]] = []
         for name in plan.group_by:
@@ -712,7 +946,8 @@ class QueryEngine:
             if chunk.base:
                 arr += chunk.base
             values[column] = arr
-        uniq, aggs = kernels.aggregate(keys, values, specs)
+        uniq, aggs = kernels.aggregate(keys, values, specs,
+                                       presorted=bool(presorted))
         # late materialization: widen only surviving group keys
         for j, (kind, aux) in enumerate(widen):
             if kind == "uniq":
@@ -722,17 +957,24 @@ class QueryEngine:
         return uniq, aggs
 
     def _cold_partial(self, plan, table, specs, part,
+                      rows_sel: Optional[np.ndarray] = None,
                       prof: Optional[QueryProfiler] = None) -> Partial:
         """Cold part: stream through the bounded decode buffer,
         decoding ONLY the plan's columns from the self-contained part
         file, adopt the subset into table code space, evaluate, drop —
         the part is never promoted (chunks stay None, tier stays
-        cold)."""
+        cold). The decode is in FILE (sort) order — aggregation is
+        row-order-insensitive in exact int64, and for a sorted part
+        this skips reading the rowid column and the un-permute
+        entirely; `rows_sel` (granule indexes survive demotion) then
+        slices the surviving granules' rows before evaluation."""
         # a plan touching NO columns (global count, no filters/window)
         # still needs the row count — carry one cheap numeric column
         cols = plan.columns_touched() or (table.schema[0].name,)
         with self._cold_sem:
-            batch = table._decode_part(part, columns=cols)
+            batch = table._decode_part_sorted(part, columns=cols)
+            if rows_sel is not None:
+                batch = batch.take(rows_sel)
             return self._decoded_partial(plan, table, specs, batch,
                                          prof)
 

@@ -86,9 +86,15 @@ class QueryProfiler:
         return None
 
     def add_part(self, uid: object, tier: str, rows: int,
-                 pruned: Optional[str] = None) -> None:
+                 pruned: Optional[str] = None,
+                 granules: Optional[Dict[str, object]] = None) -> None:
         """One part's fate: scanned, or pruned with the reason
-        (`time_window`, `range:<col>`, `codes:<col>`)."""
+        (`time_window`, `range:<col>`, `codes:<col>`, or `granules`
+        when every index granule proved empty). `granules` carries the
+        intra-part skip-index story for a sorted part — {"scanned",
+        "skipped", "reasons": {"pk:<col>"|"skip_minmax:<col>"|
+        "skip_set:<col>": granule count}} — exactly as the engine
+        decided it (engine._granule_prune)."""
         if len(self.parts) >= MAX_PROFILE_PARTS:
             self.parts_truncated += 1
             return
@@ -98,6 +104,8 @@ class QueryProfiler:
             entry["pruned"] = pruned
         else:
             entry["scanned"] = True
+        if granules is not None:
+            entry["granules"] = granules
         self.parts.append(entry)
 
     def add_matched(self, n: int) -> None:

@@ -168,26 +168,42 @@ def _jax_segment_reduce(ops, names, gids, num_segments, *vals):
 
 
 def aggregate(keys: np.ndarray, values: Dict[str, np.ndarray],
-              specs: Sequence[Tuple[str, str, Optional[str]]]
+              specs: Sequence[Tuple[str, str, Optional[str]]],
+              presorted: bool = False
               ) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
     """GROUP BY `keys` ([n, k] int64) computing every spec
     (label, op, column) over int64 `values`. Returns (unique keys
     [g, k] in lexicographic order, {label: [g] int64}).
 
     `n == 0` returns empty outputs; `k == 0` (global aggregate)
-    reduces everything into one group."""
+    reduces everything into one group.
+
+    `presorted=True` is the CONTIGUOUS-RUN fast path: the caller
+    guarantees rows with equal keys are adjacent and keys are
+    non-decreasing (a sorted part whose groupBy is a sort-key
+    prefix — engine.py proves it from the part's sort key), so the
+    lexsort is skipped entirely and group boundaries come from one
+    adjacent-row comparison. Output is bit-identical to the sorted
+    path: a stable lexsort of already-sorted keys is the identity
+    permutation."""
     n = keys.shape[0]
     if n == 0:
         return (keys.reshape(0, keys.shape[1]),
                 {label: np.zeros(0, np.int64) for label, _, _ in specs})
+    order: Optional[np.ndarray] = None
     if keys.shape[1] == 0:
-        order = np.arange(n)
         starts = np.zeros(1, np.int64)
+    elif presorted:
+        boundary = np.empty(n, dtype=bool)
+        boundary[0] = True
+        boundary[1:] = np.any(keys[1:] != keys[:-1], axis=1)
+        starts = np.flatnonzero(boundary)
     else:
         order, starts, _ = group_ids(keys)
-    sorted_vals = {c: np.ascontiguousarray(v[order])
+    sorted_vals = {c: np.ascontiguousarray(
+                       v if order is None else v[order])
                    for c, v in values.items()}
-    uniq = keys[order][starts]
+    uniq = (keys if order is None else keys[order])[starts]
     if kernel_mode() == "jax":
         try:
             gids = np.zeros(n, np.int64)

@@ -48,12 +48,46 @@ Layout:
     stamp. Parts subsume the bulk of the snapshot, load lazily, and
     are the part-shipping foundation for replication (ROADMAP item 1).
 
+Sort order + indexes (PR 12, the rest of the MergeTree read design):
+
+  * Parts seal and merge SORTED by a configurable primary key
+    (`THEIA_STORE_SORT_KEY`, default timeInserted,destinationIP,
+    sourceIP — the reference's ClickHouse ORDER BY; string columns
+    cluster by dictionary code, which groups identical values exactly
+    even though the order is code-allocation order, not lexicographic).
+  * Every sorted part carries an explicit ROW-ID column — the sort
+    permutation (`sorted_row[i]` was insertion row `rowid[i]`) — so
+    the insertion-order contract SURVIVES sorting: `scan()`/`select()`
+    un-permute on decode (byte-identical flat parity holds unchanged)
+    and positional delete masks resolve through the row-id.
+  * Each sorted part keeps a SPARSE PRIMARY INDEX + per-granule SKIP
+    INDEXES (`THEIA_STORE_GRANULE_ROWS`, default 8192): min/max zone
+    maps on every column (the sort-key prefix's zone map IS the
+    binary-searchable sparse index, since the column is sorted) and
+    bounded set indexes of distinct dictionary codes on string
+    columns. The query engine prunes at granule granularity INSIDE
+    parts — predicates decide granules from resident metadata before
+    any row is gathered (query/engine.py).
+  * Runs of sorted parts merge with a K-WAY STREAMING merge (already-
+    ordered runs concatenate; overlapping runs pay one stable key
+    sort over the sort-key columns only) instead of concat+re-encode,
+    and background maintenance UPGRADES pre-PR-12 unsorted parts
+    (format v1) to sorted+indexed v2 in place.
+  * The part format version is stamped per part in the manifest:
+    v1 parts adopt lazily (scanned, never granule-pruned) so old
+    stores load unchanged and converge via merges/upgrades.
+
 Env knobs (all also constructor-injectable for tests):
 
     THEIA_STORE_ENGINE             parts|flat (default flat)
     THEIA_STORE_MEMTABLE_ROWS      memtable rows before a seal (65536)
     THEIA_STORE_PART_ROWS          merge target part size (262144)
     THEIA_STORE_PARTITION_SECONDS  time partition width (3600)
+    THEIA_STORE_SORT_KEY           part primary key, comma-separated
+                                   columns (default timeInserted,
+                                   destinationIP,sourceIP; empty
+                                   disables sorting → v1 parts)
+    THEIA_STORE_GRANULE_ROWS       rows per index granule (8192)
     THEIA_STORE_COLD_DIR           part/manifest directory (manager
                                    default: <db path>.parts)
     THEIA_STORE_MERGE_INTERVAL     background merge cadence (5s;
@@ -97,10 +131,30 @@ DEFAULT_PARTITION_SECONDS = 3600
 #: min/max pruning stays correct, just less selective)
 MAX_PARTS_PER_SEAL = 32
 
+#: the ClickHouse-ORDER-BY equivalent: parts sort by these columns
+#: (string columns by dictionary code — identical values still
+#: cluster exactly)
+DEFAULT_SORT_KEY = "timeInserted,destinationIP,sourceIP"
+DEFAULT_GRANULE_ROWS = 8192
+#: a granule's string set index is dropped (None = "no proof") once
+#: its distinct-code count exceeds this — the ClickHouse set(N) cap
+SET_INDEX_MAX = 128
+#: v1 parts rewritten sorted+indexed per maintenance pass (bounds the
+#: one-time upgrade cost of a large pre-PR-12 store per pass)
+UPGRADES_PER_PASS = 4
+
+#: part format versions (stamped per part in the manifest AND in the
+#: part-file header): v1 = insertion order, no row-id, no indexes
+#: (pre-PR-12); v2 = sorted by the part's sort key, carries the
+#: __rowid__ permutation column, granule-indexed
+PART_FORMAT_UNSORTED = 1
+PART_FORMAT_SORTED = 2
+
 MANIFEST_NAME = "manifest.json"
 
 _PART_MAGIC = b"TPRT"
-_PART_VERSION = 1
+_PART_VERSION = PART_FORMAT_UNSORTED
+_PART_VERSIONS = (PART_FORMAT_UNSORTED, PART_FORMAT_SORTED)
 #: magic, version, crc algo, reserved, body crc, body length
 _PART_HEADER = struct.Struct("<4sBBHIQ")
 
@@ -120,6 +174,10 @@ _M_SCANNED = _metrics.counter(
 _M_DEMOTED = _metrics.counter(
     "theia_store_parts_demoted_total",
     "Hot parts demoted to the cold (disk) tier by retention")
+_M_UPGRADED = _metrics.counter(
+    "theia_store_parts_upgraded_total",
+    "Pre-PR-12 unsorted (format v1) parts rewritten sorted+indexed "
+    "(format v2) by background maintenance")
 
 
 class PartsError(Exception):
@@ -145,6 +203,129 @@ def default_store_engine() -> str:
             f"unknown store engine {name!r} (THEIA_STORE_ENGINE): "
             f"expected one of {STORE_ENGINES}")
     return name
+
+
+def default_sort_key() -> Tuple[str, ...]:
+    """THEIA_STORE_SORT_KEY parsed to a column tuple. An EMPTY value
+    disables sorting entirely (parts seal in insertion order, format
+    v1 — the pre-PR-12 behavior, kept reachable for cross-version
+    tests and as the escape hatch)."""
+    raw = os.environ.get("THEIA_STORE_SORT_KEY")
+    if raw is None:
+        raw = DEFAULT_SORT_KEY
+    return tuple(c.strip() for c in raw.split(",") if c.strip())
+
+
+# -- sparse primary index + per-granule skip indexes -----------------------
+
+def _inverse_permutation(rowid: np.ndarray) -> np.ndarray:
+    """inv with inv[rowid[i]] = i: `sorted.take(inv)` restores
+    insertion order — the decode side of the row-id contract."""
+    rid = np.asarray(rowid, np.int64)
+    inv = np.empty(len(rid), np.int64)
+    inv[rid] = np.arange(len(rid), dtype=np.int64)
+    return inv
+
+
+class PartIndexes:
+    """Resident index metadata for one SORTED part (~0.2 B/row):
+
+    * `starts` — row offset of each granule (every Nth row); with the
+      sort order, the sort-key prefix's zone map is the MergeTree
+      sparse primary index (granule g's key range is exactly
+      [zone min, zone max], binary-searchable because ascending).
+    * `zones` — per-granule (mins, maxs) for EVERY column: numeric
+      columns over values, string columns over dictionary codes (only
+      meaningful for pruning on the sort-key prefix, where codes are
+      clustered; harmless elsewhere).
+    * `sets` — per-granule sorted distinct dictionary codes for string
+      columns, or None once a granule exceeds SET_INDEX_MAX distinct
+      values (no proof → scanned).
+
+    Survives demotion (indexes stay resident when chunks spill) but
+    not recovery: a manifest-adopted part starts with indexes=None —
+    scanned, not granule-pruned — and rebuilds them on hot promotion
+    or upgrade, the same laziness as the chunks themselves."""
+
+    __slots__ = ("granule", "rows", "starts", "zones", "sets")
+
+    def __init__(self, granule: int, rows: int, starts: np.ndarray,
+                 zones: Dict[str, Tuple[np.ndarray, np.ndarray]],
+                 sets: Dict[str, List[Optional[np.ndarray]]]) -> None:
+        self.granule = granule
+        self.rows = rows
+        self.starts = starts
+        self.zones = zones
+        self.sets = sets
+
+    @property
+    def n_granules(self) -> int:
+        return len(self.starts)
+
+    def granule_ends(self) -> np.ndarray:
+        return np.append(self.starts[1:], self.rows)
+
+    @property
+    def nbytes(self) -> int:
+        n = self.starts.nbytes
+        for mins, maxs in self.zones.values():
+            n += mins.nbytes + maxs.nbytes
+        for per in self.sets.values():
+            n += sum(s.nbytes for s in per if s is not None)
+        return n
+
+
+def build_part_indexes(schema, batch: ColumnarBatch, granule: int,
+                       sort_key: Sequence[str]) -> PartIndexes:
+    """Index one SORTED batch: one reduceat pass per column for the
+    zone maps, one bounded np.unique per (granule, string column) for
+    the set indexes."""
+    n = len(batch)
+    granule = max(1, int(granule))
+    starts = np.arange(0, n, granule, dtype=np.int64)
+    ends = np.minimum(starts + granule, n)
+    zones: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+    sets: Dict[str, List[Optional[np.ndarray]]] = {}
+    for col in schema:
+        arr = np.ascontiguousarray(batch[col.name])
+        zones[col.name] = (np.minimum.reduceat(arr, starts),
+                           np.maximum.reduceat(arr, starts))
+        if col.is_string:
+            per: List[Optional[np.ndarray]] = []
+            for s, e in zip(starts, ends):
+                u = np.unique(arr[s:e])
+                per.append(u.astype(np.int32)
+                           if len(u) <= SET_INDEX_MAX else None)
+            sets[col.name] = per
+    return PartIndexes(granule, n, starts, zones, sets)
+
+
+def kway_merge_order(runs: Sequence[Sequence[np.ndarray]]
+                     ) -> Optional[np.ndarray]:
+    """Merge order for K individually-sorted runs of multi-column keys
+    (each run a list of per-column arrays, primary column first) over
+    their CONCATENATION. Returns None when the runs are already
+    globally ordered end-to-end (the common case for time-ordered
+    ingest: adjacent parts hold disjoint key ranges — the merge is a
+    concat); otherwise one stable lexsort over the key columns only.
+    Stability makes the result identical to sorting the insertion-
+    order concatenation: within a run equal keys are already in
+    insertion order, and runs concatenate in insertion order."""
+    runs = [r for r in runs if len(r) and len(r[0])]
+    if len(runs) <= 1:
+        return None
+    ordered = True
+    for a, b in zip(runs, runs[1:]):
+        last = tuple(c[-1] for c in a)
+        first = tuple(c[0] for c in b)
+        if last > first:
+            ordered = False
+            break
+    if ordered:
+        return None
+    cols = [np.concatenate([r[j] for r in runs])
+            for j in range(len(runs[0]))]
+    return np.lexsort(tuple(reversed(cols)))
 
 
 # -- column chunks (in-RAM encoded representation) -------------------------
@@ -222,14 +403,17 @@ def _encode_chunks(schema, dicts, batch: ColumnarBatch
 
 # -- part files (self-contained on-disk representation) --------------------
 
-def write_part_file(path: str, table: str,
-                    batch: ColumnarBatch) -> int:
+def write_part_file(path: str, table: str, batch: ColumnarBatch,
+                    version: int = _PART_VERSION) -> int:
     """Write one part as a checksummed, SELF-CONTAINED file: header +
     the exact WAL record body (unique strings shipped), so the file
     decodes into any dictionary state — the property that makes parts
-    shippable to replicas and reloadable across restarts. Buffered
-    write; durability is the caller's (fsync at manifest publish —
-    until then the WAL covers the rows). Returns bytes written."""
+    shippable to replicas and reloadable across restarts. `version`
+    stamps the part format (v2 = sorted rows + the __rowid__
+    permutation column riding the record encoding as an ordinary
+    numeric column — the body codec is unchanged). Buffered write;
+    durability is the caller's (fsync at manifest publish — until
+    then the WAL covers the rows). Returns bytes written."""
     parts = _wal.encode_record_parts(table, batch)
     body_len = sum(len(p) for p in parts)
     crc = 0
@@ -237,7 +421,7 @@ def write_part_file(path: str, table: str,
         crc = _wal._write_crc(p, crc)
     crc &= 0xFFFFFFFF
     with open(path, "wb") as f:
-        f.write(_PART_HEADER.pack(_PART_MAGIC, _PART_VERSION,
+        f.write(_PART_HEADER.pack(_PART_MAGIC, version,
                                   _wal._WRITE_ALGO, 0, crc, body_len))
         for p in parts:
             f.write(p)
@@ -257,7 +441,7 @@ def read_part_body(path: str) -> bytes:
         raise PartsError(f"part {path}: short header")
     magic, ver, algo, _, crc, body_len = _PART_HEADER.unpack_from(
         data, 0)
-    if magic != _PART_MAGIC or ver != _PART_VERSION:
+    if magic != _PART_MAGIC or ver not in _PART_VERSIONS:
         raise PartsError(f"part {path}: bad magic/version")
     body = data[_PART_HEADER.size:]
     if len(body) != body_len:
@@ -290,7 +474,7 @@ def read_part_file(path: str,
         raise PartsError(f"part {path}: short header")
     magic, ver, algo, _, crc, body_len = _PART_HEADER.unpack_from(
         data, 0)
-    if magic != _PART_MAGIC or ver != _PART_VERSION:
+    if magic != _PART_MAGIC or ver not in _PART_VERSIONS:
         raise PartsError(f"part {path}: bad magic/version")
     body = data[_PART_HEADER.size:]
     if len(body) != body_len:
@@ -319,15 +503,25 @@ _part_uid = itertools.count(1)
 class Part:
     """One immutable sealed part: row count + min/max pruning metadata
     always resident; column chunks resident on the hot tier, decoded
-    on demand from the self-contained file on the cold tier."""
+    on demand from the self-contained file on the cold tier.
+
+    Format v2 parts additionally carry (hot tier) the `rowid` sort
+    permutation and the granule `indexes`; rowid spills with the
+    chunks on demotion (the file holds it), indexes stay resident —
+    they are the pruning substrate and cost ~0.2 B/row."""
 
     __slots__ = ("rows", "minmax", "chunks", "path", "tier",
-                 "file_bytes", "raw_bytes", "uid")
+                 "file_bytes", "raw_bytes", "uid",
+                 "fmt", "sort_key", "rowid", "indexes")
 
     def __init__(self, rows: int, minmax: Dict[str, Tuple[int, int]],
                  chunks: Optional[Dict[str, object]],
                  path: Optional[str] = None, tier: str = "hot",
-                 file_bytes: int = 0, raw_bytes: int = 0) -> None:
+                 file_bytes: int = 0, raw_bytes: int = 0,
+                 fmt: int = PART_FORMAT_UNSORTED,
+                 sort_key: Tuple[str, ...] = (),
+                 rowid: Optional[np.ndarray] = None,
+                 indexes: Optional[PartIndexes] = None) -> None:
         self.uid = next(_part_uid)
         self.rows = rows
         self.minmax = minmax
@@ -336,13 +530,22 @@ class Part:
         self.tier = tier
         self.file_bytes = file_bytes
         self.raw_bytes = raw_bytes
+        self.fmt = fmt
+        self.sort_key = tuple(sort_key)
+        self.rowid = rowid
+        self.indexes = indexes
 
     @property
     def nbytes(self) -> int:
-        """Resident (hot-tier) encoded bytes; a demoted part costs 0."""
+        """Resident (hot-tier) encoded bytes (chunks + the rowid
+        permutation); a demoted part costs 0 — its tiny indexes are
+        metadata, like minmax, and deliberately not charged."""
         if self.chunks is None:
             return 0
-        return sum(c.nbytes for c in self.chunks.values())
+        n = sum(c.nbytes for c in self.chunks.values())
+        if self.rowid is not None:
+            n += self.rowid.nbytes
+        return n
 
     def overlaps(self, start: Optional[int], end: Optional[int],
                  time_column: str, end_column: str) -> bool:
@@ -359,7 +562,7 @@ class Part:
         return True
 
     def manifest_entry(self) -> Dict[str, object]:
-        return {
+        entry: Dict[str, object] = {
             "file": os.path.basename(self.path) if self.path else None,
             "rows": self.rows,
             "tier": self.tier,
@@ -368,6 +571,15 @@ class Part:
             "minmax": {k: [int(v[0]), int(v[1])]
                        for k, v in self.minmax.items()},
         }
+        if self.fmt != PART_FORMAT_UNSORTED:
+            # fmt is OMITTED for v1 entries, so pre-PR-12 manifests
+            # (which never carried the key) and v1 entries read the
+            # same way: absent → unsorted
+            entry["fmt"] = int(self.fmt)
+            entry["sortKey"] = list(self.sort_key)
+            if self.indexes is not None:
+                entry["granule"] = int(self.indexes.granule)
+        return entry
 
 
 def _minmax_of(batch: ColumnarBatch,
@@ -392,8 +604,27 @@ class PartTable(Table):
                  memtable_rows: Optional[int] = None,
                  part_rows: Optional[int] = None,
                  partition_seconds: Optional[int] = None,
-                 time_column: str = "timeInserted") -> None:
+                 time_column: str = "timeInserted",
+                 sort_key: Optional[object] = None,
+                 granule_rows: Optional[int] = None) -> None:
         super().__init__(name, schema)
+        # part primary key: None → env default; "" / () disables
+        # sorting (format v1, the pre-PR-12 layout). Columns the
+        # schema lacks are dropped silently so one env value serves
+        # every table shape.
+        if sort_key is None:
+            key = default_sort_key()
+        elif isinstance(sort_key, str):
+            key = tuple(c.strip() for c in sort_key.split(",")
+                        if c.strip())
+        else:
+            key = tuple(sort_key)
+        self.sort_key: Tuple[str, ...] = tuple(
+            c for c in key if any(col.name == c for col in schema))
+        self.granule_rows = max(1, (
+            env_int("THEIA_STORE_GRANULE_ROWS", DEFAULT_GRANULE_ROWS)
+            if granule_rows is None else int(granule_rows)))
+        self.parts_upgraded = 0
         # Directory is EXPLICIT-ONLY at this level: the topology
         # wrappers (FlowDatabase / Sharded / Replicated) resolve
         # THEIA_STORE_COLD_DIR and suffix shard-NNN / replica-NNN —
@@ -542,43 +773,88 @@ class PartTable(Table):
 
     def _build_part(self, batch: ColumnarBatch,
                     write_file: bool = True,
-                    resident: bool = True) -> Part:
-        """Seal one adopted batch into a Part. `write_file=False`
-        skips the on-disk copy — the delete paths rewrite parts while
-        HOLDING the table lock, and disk I/O there would stall the
-        ingest hot path; the next snapshot materializes missing files
-        outside the lock (snapshot_parts_state). `resident=False`
-        skips the in-RAM chunk encode — the cold-merge path, whose
-        product goes straight to disk."""
-        chunks = (_encode_chunks(self.schema, self.dicts, batch)
+                    resident: bool = True,
+                    presorted_rowid: Optional[np.ndarray] = None
+                    ) -> Part:
+        """Seal one adopted batch into a Part — sorted by the table's
+        sort key (format v2, with the rowid permutation + granule
+        indexes) unless sorting is disabled. `batch` is in INSERTION
+        order, except when `presorted_rowid` is given: the k-way merge
+        path hands an already-sorted batch plus its permutation, and
+        the stable re-sort is skipped. `write_file=False` skips the
+        on-disk copy — the delete paths rewrite parts while HOLDING
+        the table lock, and disk I/O there would stall the ingest hot
+        path; the next snapshot materializes missing files outside
+        the lock (snapshot_parts_state). `resident=False` skips the
+        in-RAM chunk encode — the cold-merge path, whose product goes
+        straight to disk (indexes are built either way: they are the
+        cold tier's pruning substrate)."""
+        n = len(batch)
+        fmt = PART_FORMAT_UNSORTED
+        rowid: Optional[np.ndarray] = None
+        indexes: Optional[PartIndexes] = None
+        sbatch = batch
+        if self.sort_key and n:
+            fmt = PART_FORMAT_SORTED
+            if presorted_rowid is not None:
+                rowid = np.asarray(presorted_rowid, np.uint32)
+            else:
+                order = np.lexsort(tuple(
+                    np.asarray(batch[c])
+                    for c in reversed(self.sort_key)))
+                rowid = order.astype(np.uint32)
+                if not np.array_equal(order,
+                                      np.arange(n, dtype=order.dtype)):
+                    sbatch = batch.take(order)
+            indexes = build_part_indexes(self.schema, sbatch,
+                                         self.granule_rows,
+                                         self.sort_key)
+        chunks = (_encode_chunks(self.schema, self.dicts, sbatch)
                   if resident else None)
-        minmax = _minmax_of(batch, self._prune_columns)
+        minmax = _minmax_of(sbatch, self._prune_columns)
         raw = sum(a.nbytes for a in batch.columns.values())
         path = None
         file_bytes = 0
         if self.directory and write_file:
-            path, file_bytes = self._write_file(batch)
-        return Part(len(batch), minmax, chunks, path=path,
-                    file_bytes=file_bytes, raw_bytes=raw)
+            path, file_bytes = self._write_file(sbatch, rowid)
+        return Part(n, minmax, chunks, path=path,
+                    file_bytes=file_bytes, raw_bytes=raw,
+                    fmt=fmt,
+                    sort_key=self.sort_key if fmt >= 2 else (),
+                    rowid=rowid if (resident and fmt >= 2) else None,
+                    indexes=indexes)
 
-    def _write_file(self, batch: ColumnarBatch) -> Tuple[str, int]:
+    def _write_file(self, batch: ColumnarBatch,
+                    rowid: Optional[np.ndarray] = None
+                    ) -> Tuple[str, int]:
+        """`batch` is in FILE order; a non-None `rowid` appends the
+        permutation column and stamps format v2."""
         path = os.path.join(
             self.directory, f"part-{uuid.uuid4().hex[:16]}.tprt")
         # guard BEFORE the write: a save's GC running mid-creation
         # must keep the half-written file
         self._gc_guard.add(os.path.basename(path))
-        file_bytes = write_part_file(path, self.name, batch)
+        version = _PART_VERSION
+        if rowid is not None:
+            cols = dict(batch.columns)
+            cols[_wal.ROWID_COLUMN] = np.asarray(rowid, np.int64)
+            batch = ColumnarBatch(cols, batch.dicts)
+            version = PART_FORMAT_SORTED
+        file_bytes = write_part_file(path, self.name, batch,
+                                     version=version)
         with self._fsync_lock:
             self._pending_fsync.append(path)
         return path, file_bytes
 
     def _materialize_part(self, part: Part) -> None:
-        """Write the file for a fileless (delete-rewritten) part.
-        Runs outside the table lock; the guarded swap tolerates a
-        concurrent materializer or a racing delete — the losing file
-        just becomes an unreferenced orphan the GC collects."""
-        batch = self._decode_part(part)
-        path, nbytes = self._write_file(batch)
+        """Write the file for a fileless (delete-rewritten) part, in
+        its native format (v2 parts write sorted rows + rowid from
+        the resident chunks). Runs outside the table lock; the
+        guarded swap tolerates a concurrent materializer or a racing
+        delete — the losing file just becomes an unreferenced orphan
+        the GC collects."""
+        batch, rowid = self._decode_part_sorted(part, with_rowid=True)
+        path, nbytes = self._write_file(batch, rowid)
         with self._lock:
             if part.path is None:
                 part.path, part.file_bytes = path, nbytes
@@ -595,35 +871,108 @@ class PartTable(Table):
     def _decode_part(self, part: Part,
                      columns: Optional[Sequence[str]] = None
                      ) -> ColumnarBatch:
-        """Part → ColumnarBatch in table code space. Hot parts gather
-        from resident chunks; tier-'hot' parts without chunks (lazy
-        manifest recovery) decode their file once and promote; cold
-        parts decode on demand and stay cold.
+        """Part → ColumnarBatch in table code space, in INSERTION
+        order (sorted v2 parts un-permute through their rowid — the
+        contract every parity surface and positional delete mask
+        stands on). Hot parts gather from resident chunks; tier-'hot'
+        parts without chunks (lazy manifest recovery) decode their
+        file once and promote; cold parts decode on demand and stay
+        cold.
 
         `columns` restricts the decode to that subset: resident
         chunks gather only those columns, and a FILE decode skips the
-        other columns' bytes on disk. A subset decode NEVER promotes
-        (promotion needs every column) — a lazy hot part stays lazy,
-        a cold part stays cold, which is exactly what a query that
-        touches 4 of 52 columns wants."""
-        chunks = part.chunks
+        other columns' bytes on disk (plus the rowid column for a v2
+        part — the un-permute needs it). A subset decode NEVER
+        promotes (promotion needs every column) — a lazy hot part
+        stays lazy, a cold part stays cold, which is exactly what a
+        query that touches 4 of 52 columns wants."""
+        chunks, rowid = self._resident_pair(part)
         if chunks is not None:
-            if columns is not None:
-                return ColumnarBatch(
-                    {n: chunks[n].decode() for n in columns},
-                    self.dicts)
-            return ColumnarBatch(
-                {n: c.decode() for n, c in chunks.items()}, self.dicts)
+            names = list(columns) if columns is not None else \
+                list(chunks)
+            cols = {n: chunks[n].decode() for n in names}
+            if rowid is not None:
+                inv = _inverse_permutation(rowid)
+                cols = {n: a[inv] for n, a in cols.items()}
+            return ColumnarBatch(cols, self.dicts)
+        adopted, rowid_arr = self._file_batch(part, columns)
+        if part.tier == "hot" and columns is None:
+            # promote in FILE (sorted) order; rowid + indexes first so
+            # a racing insertion-order reader that sees the chunks
+            # also sees the permutation (_resident_pair re-reads)
+            if rowid_arr is not None:
+                part.rowid = rowid_arr
+                part.indexes = build_part_indexes(
+                    self.schema, adopted, self.granule_rows,
+                    part.sort_key or self.sort_key)
+            part.chunks = _encode_chunks(self.schema, self.dicts,
+                                         adopted)
+        if rowid_arr is not None:
+            adopted = adopted.take(_inverse_permutation(rowid_arr))
+        return adopted
+
+    def _decode_part_sorted(self, part: Part,
+                            columns: Optional[Sequence[str]] = None,
+                            with_rowid: bool = False):
+        """Part → batch in FILE/chunk order (the part's SORT order for
+        v2) — the query engine's granule-sliced view and the k-way
+        merge's input. Never promotes, never un-permutes. Returns the
+        batch, or (batch, rowid-or-None) when `with_rowid` (rowid is
+        None for v1 parts)."""
+        chunks, rowid = self._resident_pair(part)
+        if chunks is not None:
+            names = list(columns) if columns is not None else \
+                list(chunks)
+            batch = ColumnarBatch(
+                {n: chunks[n].decode() for n in names}, self.dicts)
+            return (batch, rowid) if with_rowid else batch
+        want_rowid = with_rowid and part.fmt >= PART_FORMAT_SORTED
+        batch, rowid_arr = self._file_batch(
+            part, columns, want_rowid=want_rowid)
+        return (batch, rowid_arr) if with_rowid else batch
+
+    def _resident_pair(self, part: Part):
+        """Race-consistent (chunks, rowid) snapshot of a part's
+        resident state, taken lock-free against BOTH in-place
+        transitions: DEMOTION clears chunks first then rowid, so
+        reading rowid before chunks can't see chunks with the
+        permutation already gone; lazy PROMOTION sets rowid before
+        chunks, so observing fresh chunks with a stale rowid=None is
+        repaired by one re-read. If a demotion races the re-read too,
+        the file path (always present across either transition) is
+        the safe answer — chunks reports None."""
+        rowid = part.rowid
+        chunks = part.chunks
+        if chunks is not None and rowid is None and \
+                part.fmt >= PART_FORMAT_SORTED:
+            rowid = part.rowid
+            if rowid is None:
+                chunks = None
+        return chunks, rowid
+
+    def _file_batch(self, part: Part,
+                    columns: Optional[Sequence[str]] = None,
+                    want_rowid: bool = True
+                    ) -> Tuple[ColumnarBatch, Optional[np.ndarray]]:
+        """Decode a part's FILE into table code space, in FILE (sort)
+        order: (adopted batch, rowid permutation or None for v1).
+        `want_rowid=False` skips reading the rowid column's bytes on
+        a subset decode that doesn't need the permutation."""
         if part.path is None:
             raise PartsError(
                 f"part of {self.name} has neither resident chunks nor "
                 f"a file (corrupted state)")
-        raw = read_part_file(part.path, columns=columns)
-        adopted = self._adopt(raw, columns=columns)
-        if part.tier == "hot" and columns is None:
-            part.chunks = _encode_chunks(self.schema, self.dicts,
-                                         adopted)
-        return adopted
+        read_cols = columns
+        if columns is not None and want_rowid and \
+                part.fmt >= PART_FORMAT_SORTED:
+            read_cols = list(columns)
+            if _wal.ROWID_COLUMN not in read_cols:
+                read_cols.append(_wal.ROWID_COLUMN)
+        raw = read_part_file(part.path, columns=read_cols)
+        rowid_arr = raw.columns.pop(_wal.ROWID_COLUMN, None)
+        batch = self._adopt(raw, columns=columns)
+        return batch, (None if rowid_arr is None
+                       else np.asarray(rowid_arr, np.uint32))
 
     def _snapshot_refs(self) -> Tuple[List[Part], List[ColumnarBatch]]:
         with self._lock:
@@ -633,11 +982,18 @@ class PartTable(Table):
                                mem: Optional[List[ColumnarBatch]] = None,
                                chunk_rows: int = 65536):
         """Yield self-contained WAL-record BODIES covering every row of
-        this table in insertion order — the cluster resync shipping
-        format ("ship sealed parts, then the WAL tail"). COLD/lazy
-        parts ship their file body verbatim (it IS the exact record
-        body — zero decode); hot parts and the memtable encode their
-        batches. Pass refs captured under the caller's consistency
+        this table — the cluster resync shipping format ("ship sealed
+        parts, then the WAL tail"). COLD/lazy parts ship their file
+        body verbatim (it IS the exact record body — zero decode),
+        which for a sorted v2 part means the rows arrive in the part's
+        SORT order (receivers drop the __rowid__ column at adoption);
+        hot parts and the memtable encode their batches in insertion
+        order. Cross-node row parity is therefore ORDER-INSENSITIVE
+        by contract (the PR-12 oracle floor) — each node's own
+        insertion order stays self-consistent, which is all the
+        positional-delete machinery needs, but a resynced follower's
+        row order may legitimately differ from its leader's. Pass
+        refs captured under the caller's consistency
         latch; parts are immutable, so the refs stay valid after the
         latch releases (a raced maintenance GC unlinking a retired
         file falls back to the in-RAM decode path)."""
@@ -1036,9 +1392,14 @@ class PartTable(Table):
                 # tier BEFORE chunks: a lock-free reader (the query
                 # engine) that observes chunks=None must also observe
                 # tier=cold, or it would take the lazy-hot decode
-                # path and promote the part we just demoted
+                # path and promote the part we just demoted. chunks
+                # BEFORE rowid: _decode_part reads rowid first, so it
+                # can never see resident chunks whose permutation is
+                # already gone. The granule indexes stay resident —
+                # they are what lets cold queries keep pruning.
                 part.tier = "cold"
                 part.chunks = None
+                part.rowid = None
                 self.parts_demoted += 1
                 _M_DEMOTED.inc()
         return freed
@@ -1049,14 +1410,18 @@ class PartTable(Table):
         """One maintenance pass: merge runs of ADJACENT small parts in
         the same time partition (adjacency preserves global insertion
         order) — hot runs in RAM, cold runs on disk without
-        re-promotion — materialize files for delete-rewritten
-        parts, and — for tables that never publish a manifest
-        (sharded/replicated shards, whose wholesale snapshots don't
-        consult part files) — collect unreferenced files, which would
-        otherwise accumulate forever since every delete defers its
-        unlink to a publish-time GC that never runs there. Returns
-        merges performed."""
+        re-promotion — upgrade a bounded number of pre-PR-12 v1
+        parts to sorted+indexed v2 in place, materialize files for
+        delete-rewritten parts, and — for tables that never publish
+        a manifest (sharded/replicated shards, whose wholesale
+        snapshots don't consult part files) — collect unreferenced
+        files, which would otherwise accumulate forever since every
+        delete defers its unlink to a publish-time GC that never runs
+        there. Returns merges performed (upgrades count: a store with
+        pending upgrades keeps its maintenance cadence busy)."""
         merges = self._merge_pass()
+        if self.sort_key:
+            merges += self._upgrade_pass()
         if self.directory:
             with self._lock:
                 missing = [p for p in self._parts if p.path is None]
@@ -1083,17 +1448,100 @@ class PartTable(Table):
                     break
         return merges
 
+    def _kway_merged(self, refs: List[Part]
+                     ) -> Tuple[ColumnarBatch, np.ndarray]:
+        """K-way streaming merge of a run of SORTED parts: decode each
+        part in its sort order (no un-permute, no re-sort), compute
+        the merge order from the sort-key columns only (already-
+        ordered runs concatenate for free — kway_merge_order), and
+        carry the rowid permutations through with each part's rows
+        offset by its predecessors' row counts, so the merged part's
+        insertion order is exactly the concatenation of the sources'.
+        Returns (merged sorted batch, merged rowid)."""
+        batches: List[ColumnarBatch] = []
+        rowids: List[np.ndarray] = []
+        off = 0
+        for p in refs:
+            b, rid = self._decode_part_sorted(p, with_rowid=True)
+            if rid is None:
+                raise PartsError(
+                    f"part of {self.name} claims format v2 but has "
+                    f"no rowid permutation")
+            batches.append(b)
+            rowids.append(np.asarray(rid, np.int64) + off)
+            off += p.rows
+        order = kway_merge_order(
+            [[np.asarray(b[c]) for c in self.sort_key]
+             for b in batches])
+        merged = ColumnarBatch.concat(batches)
+        rowid = np.concatenate(rowids)
+        if order is not None:
+            merged = merged.take(order)
+            rowid = rowid[order]
+        return merged, rowid.astype(np.uint32)
+
+    def _upgrade_pass(self) -> int:
+        """Rewrite up to UPGRADES_PER_PASS format-v1 parts as sorted+
+        indexed v2, tier preserved (a cold v1 part rewrites straight
+        to disk, never promoting a byte). The path old stores take to
+        granule pruning without an explicit migration step. Same
+        guarded-swap discipline as _merge_run: the rebuild happens
+        outside the lock, and a part a concurrent delete already
+        replaced just leaves an orphan file for the GC."""
+        with self._lock:
+            candidates = [p for p in self._parts
+                          if p.fmt < PART_FORMAT_SORTED and p.rows
+                          and (p.tier == "hot" or self.directory)
+                          ][:UPGRADES_PER_PASS]
+        upgraded = 0
+        for old in candidates:
+            batch = self._decode_part(old)      # insertion order
+            hot = old.tier == "hot"
+            new_part = self._build_part(
+                batch, write_file=not hot, resident=hot)
+            new_part.tier = old.tier
+            with self._lock:
+                try:
+                    i = self._parts.index(old)
+                except ValueError:
+                    i = -1
+                if i >= 0:
+                    self._parts[i] = new_part
+            if i < 0:
+                self._retire_file(new_part)
+                continue
+            self._retire_file(old)
+            self.parts_upgraded += 1
+            upgraded += 1
+            _M_UPGRADED.inc()
+        return upgraded
+
     def _merge_run(self, refs: List[Part], tier: str) -> bool:
         """Compact one run into a single part of the SAME tier. A cold
         run's replacement is written straight to disk and registered
         cold (chunks None) — a long-retention tier coalesces its tiny
         files WITHOUT re-promoting a byte into RAM; the source parts'
-        transient decode is bounded by the run's row budget."""
+        transient decode is bounded by the run's row budget.
+
+        A run of format-v2 parts sharing the table's sort key takes
+        the K-WAY STREAMING path (_kway_merged); mixed or v1 runs
+        fall back to concat+rebuild — which, with a sort key
+        configured, produces a v2 part, i.e. merges UPGRADE old
+        parts."""
         # decode + re-encode OUTSIDE the lock (parts are immutable);
         # swap in only if the run is still intact
-        merged = ColumnarBatch.concat(
-            [self._decode_part(p) for p in refs])
-        new_part = self._build_part(merged, resident=(tier == "hot"))
+        if self.sort_key and all(
+                p.fmt >= PART_FORMAT_SORTED
+                and p.sort_key == self.sort_key for p in refs):
+            merged, rowid = self._kway_merged(refs)
+            new_part = self._build_part(merged,
+                                        resident=(tier == "hot"),
+                                        presorted_rowid=rowid)
+        else:
+            merged = ColumnarBatch.concat(
+                [self._decode_part(p) for p in refs])
+            new_part = self._build_part(merged,
+                                        resident=(tier == "hot"))
         if tier == "cold":
             new_part.tier = "cold"
         with self._lock:
@@ -1330,7 +1778,13 @@ class PartTable(Table):
                 None, path=path,
                 tier=e.get("tier", "hot"),
                 file_bytes=size,
-                raw_bytes=int(e.get("rawBytes", 0))))
+                raw_bytes=int(e.get("rawBytes", 0)),
+                # pre-PR-12 entries carry no fmt → v1: adopted
+                # lazily, scanned, never granule-pruned, upgraded by
+                # background merges. v2 entries decode through their
+                # rowid; indexes rebuild on hot promotion.
+                fmt=int(e.get("fmt", PART_FORMAT_UNSORTED)),
+                sort_key=tuple(e.get("sortKey") or ())))
         with self._lock:
             self.rows_inserted_total += sum(p.rows for p in parts)
             self.bytes_inserted_total += sum(p.raw_bytes
@@ -1431,6 +1885,7 @@ class PartTable(Table):
                             for v in b.columns.values())
         hot = [p for p in parts if p.tier == "hot"]
         cold = [p for p in parts if p.tier != "hot"]
+        indexed = [p for p in parts if p.indexes is not None]
         return {
             "count": len(parts),
             "hot": len(hot),
@@ -1444,9 +1899,43 @@ class PartTable(Table):
             "merges": self.parts_merged,
             "coldMerges": self.parts_merged_cold,
             "demoted": self.parts_demoted,
+            "sorted": sum(1 for p in parts
+                          if p.fmt >= PART_FORMAT_SORTED),
+            "upgraded": self.parts_upgraded,
+            "sortKey": list(self.sort_key),
+            "granuleRows": self.granule_rows,
+            "indexedParts": len(indexed),
+            "indexBytes": sum(p.indexes.nbytes for p in indexed),
+            "granules": sum(p.indexes.n_granules for p in indexed),
             "generation": self.manifest_generation,
             "directory": self.directory,
         }
+
+    def parts_debug_entries(self, limit: int = 256
+                            ) -> List[Dict[str, object]]:
+        """Per-part inspection rows for GET /debug/parts (bounded:
+        a month-scale store can hold thousands of parts)."""
+        col = self.part_time_column or "timeInserted"
+        with self._lock:
+            parts = list(self._parts)
+        out: List[Dict[str, object]] = []
+        for p in parts[:max(0, int(limit))]:
+            idx = p.indexes
+            entry: Dict[str, object] = {
+                "uid": p.uid,
+                "tier": p.tier,
+                "fmt": p.fmt,
+                "rows": p.rows,
+                "residentBytes": p.nbytes,
+                "fileBytes": p.file_bytes,
+                "timeRange": list(p.minmax.get(col) or ()),
+            }
+            if idx is not None:
+                entry["granules"] = idx.n_granules
+                entry["granuleRows"] = idx.granule
+                entry["indexBytes"] = idx.nbytes
+            out.append(entry)
+        return out
 
 
 # -- supervised background compaction loop --------------------------------

@@ -116,6 +116,15 @@ CRC_ALGO_ZLIB = 2
 #: it never touches a stream's dictionary-delta chain
 RECORD_MAGIC = b"TREC"
 
+#: reserved column name carried by SORTED part bodies (store/parts.py
+#: format v2): the sort permutation — `sorted_row[i]` was insertion row
+#: `rowid[i]` of the part — rides the record encoding as an ordinary
+#: numeric column (width-reduced like any other), so sorted part files
+#: stay self-contained WAL record bodies. Consumers that replay a part
+#: body as an ingest record (cluster resync) simply drop it at table
+#: adoption: schema-driven `_adopt` never copies unknown columns.
+ROWID_COLUMN = "__rowid__"
+
 _SEG_MAGIC = b"TWAL"
 _SEG_VERSION = 1
 _SEG_HEADER = struct.Struct("<4sBBHQ")      # magic, ver, algo, 0, first lsn
