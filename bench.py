@@ -100,12 +100,14 @@ def _parse_args(argv=None):
     p = argparse.ArgumentParser(
         description="theia-tpu benchmark driver (one JSON result "
                     "line on stdout, whatever happens)")
-    p.add_argument("--out", default="",
-                   help="also write the result as a schema-versioned "
-                        "JSON artifact (host metadata + per-leg "
-                        "values) to this path — reproducible "
-                        "BENCH_*.json instead of numbers living in "
-                        "changelog prose")
+    p.add_argument("--out", default="BENCH_latest.json",
+                   help="write the result as a schema-versioned JSON "
+                        "artifact (host metadata + per-leg values) to "
+                        "this path — reproducible BENCH_*.json "
+                        "instead of numbers living in changelog "
+                        "prose. Default BENCH_latest.json, so every "
+                        "run leaves a machine-readable trajectory "
+                        "point; pass --out '' to skip the artifact")
     return p.parse_args(argv)
 
 
@@ -1468,6 +1470,158 @@ def run_benchmarks() -> dict:
         print(f"query bench skipped: {e}", file=sys.stderr)
         traceback.print_exc(file=sys.stderr)
 
+    # Metrics history (scrape-to-store, PR 13): (A) A/B ingest with a
+    # REAL MetricsHistoryLoop thread scraping at a hot cadence vs the
+    # plane disabled (THEIA_METRICS_SCRAPE_INTERVAL=0 semantics — no
+    # loop at all), reporting the e2e ingest overhead of self-scrape
+    # (budget: within host noise, well under the PR-3 3% bar); (B) a
+    # 6h-window aggregation over a downsampled `__metrics__` store —
+    # the p95-dashboard query shape (bucket series folded per metric/
+    # labels) answered from rollup-tier parts — with a raw-vs-rolled
+    # parity gate before the timed windows. THEIA_BENCH_FAST shrinks
+    # both to a smoke.
+    metrics_history_bench: dict = {}
+    try:
+        import contextlib as _mh_ctx
+
+        from theia_tpu.ingest import BlockEncoder as _MhEnc
+        from theia_tpu.ingest import native_available as _mh_native
+        from theia_tpu.manager.ingest import IngestManager as _MhIm
+        from theia_tpu.obs import history as _mh_history
+        from theia_tpu.query import QueryEngine as _MhEng
+        from theia_tpu.query import parse_plan as _mh_parse
+        from theia_tpu.schema import METRICS_SCHEMA as _MH_SCHEMA
+        from theia_tpu.schema import ColumnarBatch as _MhCB
+        from theia_tpu.store import FlowDatabase as _MhDb
+
+        fast_mh = os.environ.get("THEIA_BENCH_FAST") == "1"
+        if _mh_native():
+            def cpu_ctx_mh():
+                try:
+                    return jax.default_device(jax.devices("cpu")[0])
+                except Exception:
+                    return _mh_ctx.nullcontext()
+            big_mh = generate_flows(SynthConfig(n_series=2000,
+                                                points_per_series=30))
+            n_payloads = 3 if fast_mh else 9
+
+            def mh_ingest_pass(with_loop: bool) -> float:
+                dbm = _MhDb(ttl_seconds=12 * 3600)
+                imm = _MhIm(dbm)
+                loop = None
+                if with_loop:
+                    # 1 s cadence — 15x hotter than the production
+                    # default, so a ~1 s timed pass pays at least one
+                    # real scrape+maintain tick without turning the
+                    # leg into a scrape-throughput microbench
+                    loop = _mh_history.MetricsHistoryLoop(
+                        dbm, interval=1.0)
+                    loop.start()
+                encm = _MhEnc(dicts=big_mh.dicts)
+                payloads = [encm.encode(big_mh)
+                            for _ in range(n_payloads)]
+                imm.ingest(payloads[0])   # warm dicts + jit
+                tm = time.perf_counter()
+                n = sum(imm.ingest(p)["rows"] for p in payloads[1:])
+                dtm = time.perf_counter() - tm
+                if loop is not None:
+                    loop.stop()
+                imm.close()
+                return n / dtm
+
+            # interleaved best-of-N (the metrics-overhead leg's
+            # discipline): host drift must not masquerade as overhead
+            rates_mh = {"off": 0.0, "on": 0.0}
+            with cpu_ctx_mh():
+                for _ in range(2 if fast_mh else 3):
+                    rates_mh["off"] = max(rates_mh["off"],
+                                          mh_ingest_pass(False))
+                    rates_mh["on"] = max(rates_mh["on"],
+                                         mh_ingest_pass(True))
+            metrics_history_bench[
+                "metrics_history_ingest_rows_per_sec"] = round(
+                    rates_mh["on"])
+            if rates_mh["off"] > 0:
+                metrics_history_bench[
+                    "metrics_history_overhead_pct"] = round(
+                        (rates_mh["off"] - rates_mh["on"])
+                        / rates_mh["off"] * 100, 2)
+            print(f"ingest with metrics history: "
+                  f"{rates_mh['on']:,.0f} rows/s (off: "
+                  f"{rates_mh['off']:,.0f}; overhead "
+                  f"{metrics_history_bench.get('metrics_history_overhead_pct')}%)",
+                  file=sys.stderr)
+
+        # (B) 6h-window history query from downsampled parts
+        span = 1800 if fast_mh else 21600   # the "6h" window
+        raw_mh, roll_mh = _MhDb(), _MhDb()
+        hist_rng = np.random.default_rng(5)
+        n_series_mh = 4 if fast_mh else 24
+        totals = np.zeros(n_series_mh)
+        rows_buf: list = []
+
+        def flush_mh():
+            for dmh in (raw_mh, roll_mh):
+                tabm = _mh_history.metrics_table(dmh)
+                tabm.insert(_MhCB.from_rows(
+                    rows_buf, _MH_SCHEMA, tabm.dicts))
+                tabm.seal()
+            rows_buf.clear()
+
+        for t in range(0, span, 15):
+            totals += hist_rng.integers(0, 1000, n_series_mh)
+            for s in range(n_series_mh):
+                v = int(totals[s]) * 1_000_000
+                rows_buf.append({
+                    "timeInserted": t, "metric": "bench_lat_bucket",
+                    "labels": f"le={s}", "node": "n0",
+                    "kind": "bucket", "resolution": 15, "value": v,
+                    "valueMin": v, "valueMax": v, "valueSum": v,
+                    "valueCount": 1})
+            if t % 900 == 0 and rows_buf:
+                flush_mh()
+        if rows_buf:   # the ticks after the last 900s boundary
+            flush_mh()
+        roll_loop = _mh_history.MetricsHistoryLoop(
+            roll_mh, interval=15, retention_seconds=0,
+            tiers=[(60, 600), (3600, 3600)])
+        roll_loop.maintain(now=span)
+        hist_plan = _mh_parse({
+            "table": "__metrics__", "groupBy": "metric,labels",
+            "agg": ["min:valueMin", "max:valueMax", "sum:valueSum",
+                    "sum:valueCount"],
+            "start": 0, "end": span, "k": 0})
+        eng_raw_mh = _MhEng(raw_mh)
+        eng_roll_mh = _MhEng(roll_mh)
+        r_raw = eng_raw_mh.execute(hist_plan, use_cache=False)
+        r_roll = eng_roll_mh.execute(hist_plan, use_cache=False)
+        parity_mh = r_raw["rows"] == r_roll["rows"]
+        metrics_history_bench["metrics_history_rollup_parity_ok"] = \
+            parity_mh
+        if parity_mh:
+            t_hq: list = []
+            for _ in range(3 if fast_mh else 9):
+                tq = time.perf_counter()
+                eng_roll_mh.execute(hist_plan, use_cache=False)
+                t_hq.append(time.perf_counter() - tq)
+            leg_stats["metrics_history_query"] = _leg_stats(t_hq)
+            metrics_history_bench["metrics_history_query_ms"] = round(
+                sorted(t_hq)[len(t_hq) // 2] * 1e3, 3)
+            metrics_history_bench[
+                "metrics_history_rollup_rows_scanned"] = \
+                int(r_roll["rowsScanned"])
+            metrics_history_bench[
+                "metrics_history_raw_rows_scanned"] = \
+                int(r_raw["rowsScanned"])
+        print("metrics history: " + ", ".join(
+            f"{k.replace('metrics_history_', '')} {v}"
+            for k, v in metrics_history_bench.items()),
+            file=sys.stderr)
+    except Exception as e:
+        import traceback
+        print(f"metrics-history bench skipped: {e}", file=sys.stderr)
+        traceback.print_exc(file=sys.stderr)
+
     # Overload behavior through a REAL manager (ephemeral port), two
     # phases: (A) flat-out exactly-once producers with admission
     # unlimited measure the HTTP-path capacity of this host; (B) the
@@ -2109,6 +2263,8 @@ def run_benchmarks() -> dict:
         result["query_parity_ok"] = query_parity_ok
     if query_bench:
         result.update(query_bench)
+    if metrics_history_bench:
+        result.update(metrics_history_bench)
     if leg_stats:
         result["leg_stats"] = leg_stats
     if overload:

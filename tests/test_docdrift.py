@@ -1,5 +1,7 @@
 """Doc-drift gate: the metrics catalogue (docs/metrics.md) and the
-process registry must name exactly the same metrics.
+process registry must name exactly the same metrics, and the doc's
+environment-knob table must match the knobs the code reads (for the
+env-var families this doc owns).
 
 Direction 1 (undocumented): every metric the package registers — at
 import time across every module, plus the scrape-time gauges a
@@ -7,7 +9,9 @@ fully-featured manager registers on its first /metrics render — must
 have a row in docs/metrics.md. Direction 2 (stale docs): every metric
 the catalogue names must actually be registered. A rename, removal,
 or new metric that touches only one side fails tier-1 instead of
-silently drifting.
+silently drifting. The same two directions hold for the observability
+env vars (THEIA_METRICS_*, THEIA_TRACE_*, THEIA_ALERT_*,
+THEIA_QUERY_SLOW_*): referenced-in-code ⇔ documented-in-table.
 """
 
 import importlib
@@ -93,3 +97,34 @@ def test_metrics_docs_in_sync(monkeypatch, tmp_path):
     assert not stale, (
         f"docs/metrics.md names metrics nothing registers "
         f"(renamed or removed?): {stale}")
+
+
+#: env-var families whose single source of documentation is
+#: docs/metrics.md's knob table (other THEIA_* families are owned by
+#: other docs — cluster.md, queries.md, ingest.md)
+_ENV_PREFIXES = ("THEIA_METRICS_", "THEIA_TRACE_", "THEIA_ALERT_",
+                 "THEIA_QUERY_SLOW_")
+
+_ENV_REF = re.compile(r"THEIA_[A-Z0-9_]+")
+
+#: knob-table rows: `| `THEIA_FOO` | default | meaning |`
+_ENV_ROW = re.compile(r"^\|\s*`(THEIA_[A-Z0-9_]+)`", re.MULTILINE)
+
+
+def test_metrics_env_knobs_in_sync():
+    referenced = set()
+    for path in sorted(PACKAGE_DIR.rglob("*.py")):
+        for name in _ENV_REF.findall(path.read_text()):
+            if name.startswith(_ENV_PREFIXES):
+                referenced.add(name)
+    documented = {name for name in
+                  _ENV_ROW.findall(METRICS_MD.read_text())
+                  if name.startswith(_ENV_PREFIXES)}
+    undocumented = sorted(referenced - documented)
+    stale = sorted(documented - referenced)
+    assert not undocumented, (
+        f"observability env vars read by code but missing from "
+        f"docs/metrics.md's knob table: {undocumented}")
+    assert not stale, (
+        f"docs/metrics.md documents observability env vars nothing "
+        f"reads (renamed or removed?): {stale}")

@@ -1,0 +1,906 @@
+"""Metrics history (scrape-to-store) + alert rules.
+
+The contract under test: the MetricsHistoryLoop snapshots the process
+registry into the parts-backed `__metrics__` table (exposition-shaped
+series names, exact micro-unit values); the downsample cascade swaps
+raw parts for rollup parts whose min/max/sum/count folds are EXACT
+(aligned-window aggregations answer bit-identically from raw or
+rolled-up parts); the query plane serves `table=__metrics__` through
+the same engine as flows (locally and scatter-gathered cluster-wide);
+concurrent sharded ingest cannot produce a non-monotone stored counter
+series (the striped-counter merge is exact); kill -9 mid-scrape leaves
+the table loadable and gap-only (a scrape insert is one WAL record —
+all-or-nothing on replay, never torn or double-counted); and the
+declarative rules engine fires/resolves with hysteresis, hot-reloads,
+and survives malformed rule files.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from theia_tpu.data.synth import SynthConfig, generate_flows
+from theia_tpu.ingest import BlockEncoder
+from theia_tpu.manager.ingest import IngestManager
+from theia_tpu.obs import history, metrics, rules
+from theia_tpu.obs.history import MetricsHistoryLoop
+from theia_tpu.query import PlanError, QueryEngine, parse_plan
+from theia_tpu.schema import (
+    METRICS_SCHEMA,
+    METRICS_VALUE_SCALE,
+    ColumnarBatch,
+)
+from theia_tpu.store import FlowDatabase
+
+pytestmark = pytest.mark.metrics_history
+
+SCALE = METRICS_VALUE_SCALE
+
+
+def _series_rows(node: str, metric: str, values, t0: int = 0,
+                 step: int = 15, kind: str = "counter",
+                 labels: str = ""):
+    """Synthetic raw scrape rows for one series: `values` are NATURAL
+    units at successive ticks."""
+    rows = []
+    for i, v in enumerate(values):
+        s = int(round(v * SCALE))
+        rows.append({
+            "timeInserted": t0 + i * step, "metric": metric,
+            "labels": labels, "node": node, "kind": kind,
+            "resolution": step, "value": s, "valueMin": s,
+            "valueMax": s, "valueSum": s, "valueCount": 1})
+    return rows
+
+
+def _insert(db, rows):
+    tab = history.metrics_table(db)
+    # facades without table-level dicts (DistributedTable) take a
+    # fresh-dict batch, same as the scrape loop
+    tab.insert(ColumnarBatch.from_rows(rows, METRICS_SCHEMA,
+                                       getattr(tab, "dicts", None)))
+
+
+def _scan_series(db, metric, node=None):
+    """[(time, resolution, value, vmin, vmax, vsum, vcount)] sorted by
+    time for one stored series."""
+    data = history.metrics_table(db).scan()
+    names = data.strings("metric")
+    keep = names == metric
+    if node is not None:
+        keep &= data.strings("node") == node
+    out = sorted(zip(
+        np.asarray(data["timeInserted"])[keep].tolist(),
+        np.asarray(data["resolution"])[keep].tolist(),
+        np.asarray(data["value"])[keep].tolist(),
+        np.asarray(data["valueMin"])[keep].tolist(),
+        np.asarray(data["valueMax"])[keep].tolist(),
+        np.asarray(data["valueSum"])[keep].tolist(),
+        np.asarray(data["valueCount"])[keep].tolist(),
+    ))
+    return out
+
+
+# -- scrape shape ---------------------------------------------------------
+
+
+def test_snapshot_rows_match_exposition_series():
+    """Counters/gauges scrape one row per child under the declared
+    name; histograms scrape `_bucket` (le in labels) + `_sum` +
+    `_count` — the exact series set `GET /metrics` exposes."""
+    reg = metrics.Registry()
+    c = reg.counter("t_jobs_total", "x", labelnames=("kind",))
+    c.labels(kind="a").inc(3)
+    c.labels(kind="b").inc(5)
+    reg.gauge("t_depth", "x").set(2.5)
+    h = reg.histogram("t_lat_seconds", "x")
+    h.observe(0.5)
+    h.observe(2.0)
+    rows = history.snapshot_registry_rows(1000, node="n1",
+                                          registry=reg)
+    by_metric = {}
+    for r in rows:
+        by_metric.setdefault(r["metric"], []).append(r)
+    assert {r["labels"] for r in by_metric["t_jobs_total"]} == \
+        {"kind=a", "kind=b"}
+    assert all(r["kind"] == "counter" and r["node"] == "n1"
+               and r["timeInserted"] == 1000
+               for r in by_metric["t_jobs_total"])
+    (g,) = by_metric["t_depth"]
+    assert g["value"] == int(2.5 * SCALE)
+    assert [r["value"] for r in by_metric["t_lat_seconds_count"]] == \
+        [2 * SCALE]
+    assert by_metric["t_lat_seconds_sum"][0]["value"] == \
+        int(2.5 * SCALE)
+    buckets = by_metric["t_lat_seconds_bucket"]
+    assert any("le=+Inf" in r["labels"] for r in buckets)
+    # cumulative bucket counts are non-decreasing in le order, ending
+    # at the count
+    assert buckets[-1]["value"] == 2 * SCALE
+
+
+def test_loop_scrape_seal_query_explain_resolution():
+    """Loop ticks land in sealed sorted parts; the query plane answers
+    `table=__metrics__` and EXPLAIN names each part's resolution."""
+    db = FlowDatabase()
+    loop = MetricsHistoryLoop(db, interval=15, node="n1",
+                              retention_seconds=0, tiers=[])
+    for t in range(0, 150, 15):
+        assert loop.run_once(now=1000 + t) > 0
+    assert loop.ticks == 10 and loop.failures == 0
+    eng = QueryEngine(db)
+    doc = eng.execute(parse_plan({
+        "table": "__metrics__", "groupBy": "metric",
+        "agg": ["max:value"], "k": 0}), explain=True)
+    assert doc["engine"] == "parts"
+    assert doc["groupCount"] > 10
+    parts = doc["profile"]["parts"]
+    assert parts and all(p.get("resolution") == 15 for p in parts)
+    # the loop's own counters are stored series now
+    names = {r["metric"] for r in doc["rows"]}
+    assert "theia_metrics_history_rows_total" in names
+
+
+def test_plan_table_validation_and_defaults():
+    plan = parse_plan({"table": "__metrics__"})
+    assert plan.table == "__metrics__"
+    # point-in-time samples: both window columns default to the
+    # sample time
+    assert plan.time_column == "timeInserted"
+    assert plan.end_column == "timeInserted"
+    assert parse_plan({}).table == "flows"
+    with pytest.raises(PlanError):
+        parse_plan({"table": "no_such_table"})
+    with pytest.raises(PlanError):
+        # flow columns do not resolve against the metrics schema
+        parse_plan({"table": "__metrics__",
+                    "groupBy": "destinationIP"})
+
+
+def test_metrics_partial_frame_roundtrip():
+    """TQPF partials carry metric/label strings for `__metrics__`
+    plans (the coordinator merge path)."""
+    from theia_tpu.query.distributed import (pack_partial,
+                                             partial_from_batch,
+                                             unpack_partial)
+    db = FlowDatabase()
+    _insert(db, _series_rows("n1", "x_total", [1, 2, 3]))
+    plan = parse_plan({"table": "__metrics__",
+                       "groupBy": "metric,labels",
+                       "agg": ["max:valueMax"], "k": 0})
+    keys, aggs = QueryEngine(db).execute_partial(plan)
+    raw = pack_partial({"node": "n1"}, plan, keys, aggs)
+    meta, batch = unpack_partial(raw)
+    keys2, aggs2 = partial_from_batch(plan, batch)
+    assert meta["node"] == "n1"
+    assert [k.tolist() for k in keys2] == [
+        [str(v) for v in keys[0]], [str(v) for v in keys[1]]]
+    assert aggs2["max(valueMax)"].tolist() == \
+        aggs["max(valueMax)"].tolist()
+
+
+# -- downsampling ---------------------------------------------------------
+
+
+def test_rollup_fold_exact_and_value_is_last():
+    """One series folded 15s→60s: value keeps the bucket's LAST
+    sample (the exact bucket-end total of a cumulative counter);
+    min/max/sum/count fold exactly."""
+    db = FlowDatabase()
+    _insert(db, _series_rows("n1", "c_total", [1, 2, 3, 4, 5, 6, 7, 8],
+                             t0=0, step=15))
+    table = history.metrics_table(db)
+    table.seal()
+    replaced = history.downsample_table(table, now=10_000,
+                                        tiers=[(60, 60)])
+    assert replaced == 1
+    series = _scan_series(db, "c_total")
+    assert [(t, r) for t, r, *_ in series] == [(0, 60), (60, 60)]
+    t0, t1 = series
+    # bucket 0 folds samples 1..4, bucket 1 folds 5..8
+    assert t0[2:] == (4 * SCALE, 1 * SCALE, 4 * SCALE,
+                      (1 + 2 + 3 + 4) * SCALE, 4)
+    assert t1[2:] == (8 * SCALE, 5 * SCALE, 8 * SCALE,
+                      (5 + 6 + 7 + 8) * SCALE, 4)
+
+
+def test_rollup_cascade_window_parity_bitexact():
+    """The acceptance bar: an aligned-window min/max/sum/count/mean
+    aggregation answers BIT-IDENTICALLY from downsampled parts and
+    from the raw points, and EXPLAIN proves the downsampled store
+    scanned rollup-tier parts."""
+    raw_db, roll_db = FlowDatabase(), FlowDatabase()
+    loop = MetricsHistoryLoop(roll_db, interval=15, node="n1",
+                              retention_seconds=0,
+                              tiers=[(60, 600), (3600, 3600)])
+    rng = np.random.default_rng(7)
+    total = 0.0
+    for t in range(0, 7200, 15):
+        total += float(rng.integers(0, 1000))
+        rows = _series_rows("n1", "r_total", [total], t0=t)
+        rows += _series_rows("n1", "g_depth",
+                             [float(rng.integers(0, 50))], t0=t,
+                             kind="gauge")
+        _insert(raw_db, rows)
+        _insert(roll_db, rows)
+        if t % 60 == 0:
+            for d in (raw_db, roll_db):
+                history.metrics_table(d).seal()
+        loop.maintain(now=t)
+    assert loop.parts_rolled_up > 0
+    # the four MERGEABLE aggregates are the exactness contract;
+    # mean() across tiers is sum(valueSum)/sum(valueCount), computed
+    # by the caller — a row-weighted mean() aggregate is NOT
+    # tier-invariant (rollups change the row count by design)
+    plan_doc = {"table": "__metrics__", "groupBy": "metric,kind",
+                "agg": ["min:valueMin", "max:valueMax",
+                        "sum:valueSum", "sum:valueCount"],
+                "start": 0, "end": 7200, "k": 0}
+    raw = QueryEngine(raw_db).execute(parse_plan(plan_doc),
+                                      use_cache=False)
+    rolled = QueryEngine(roll_db).execute(parse_plan(plan_doc),
+                                          use_cache=False,
+                                          explain=True)
+    assert rolled["rows"] == raw["rows"]
+    # fewer rows scanned, and the parts scanned are rollup tiers
+    assert rolled["rowsScanned"] < raw["rowsScanned"]
+    scanned = [p for p in rolled["profile"]["parts"]
+               if p.get("scanned")]
+    assert scanned and any(p.get("resolution") in (60, 3600)
+                           for p in scanned)
+
+
+def test_mixed_resolution_rows_pass_through_fold():
+    """Recovery can reseal mixed-resolution batches: rows already at
+    or above the target resolution pass through a fold unchanged."""
+    db = FlowDatabase()
+    _insert(db, _series_rows("n1", "m_total", [1, 2], t0=0, step=15))
+    coarse = _series_rows("n1", "m_total", [9], t0=600, step=15)
+    coarse[0]["resolution"] = 60
+    _insert(db, coarse)
+    table = history.metrics_table(db)
+    table.seal()
+    history.downsample_table(table, now=10_000, tiers=[(60, 60)])
+    series = _scan_series(db, "m_total")
+    assert [(t, r) for t, r, *_ in series] == [(0, 60), (600, 60)]
+
+
+def test_retention_expires_old_rows():
+    db = FlowDatabase()
+    loop = MetricsHistoryLoop(db, interval=15, node="n1",
+                              retention_seconds=100, tiers=[])
+    _insert(db, _series_rows("n1", "old_total", [1, 2], t0=0))
+    _insert(db, _series_rows("n1", "new_total", [1], t0=500))
+    history.metrics_table(db).seal()
+    out = loop.maintain(now=500)
+    assert out["rowsExpired"] == 2
+    assert _scan_series(db, "old_total") == []
+    assert len(_scan_series(db, "new_total")) == 1
+
+
+def test_follower_skips_scrape_but_maintains():
+    """A node that must not take local writes (follower: its WAL is
+    the leader's log) records nothing, but downsample/retention still
+    run (they are WAL-invisible and deterministic)."""
+    db = FlowDatabase()
+    loop = MetricsHistoryLoop(db, interval=15, node="f1",
+                              retention_seconds=100, tiers=[],
+                              accepts_writes=lambda: False)
+    _insert(db, _series_rows("f1", "x_total", [1], t0=0))
+    history.metrics_table(db).seal()
+    assert loop.run_once(now=500) == 0
+    assert loop.rows_recorded == 0
+    assert loop.rows_expired == 1   # retention still ran
+    assert len(history.metrics_table(db)) == 0
+
+
+def test_loop_on_sharded_and_replicated_stores():
+    """The scrape insert goes through the store facade — the sharded
+    DistributedTable (fresh-dict batch, per-shard adoption) and the
+    replicated fan-out proxy both record, maintain, and answer
+    queries. The replicated-of-sharded nesting (the manager's
+    --replicas R --shards N wiring) must resolve every shard of every
+    replica: the `_ReplicatedTable.__getattr__` proxy forwards
+    `tables` to the ACTIVE replica, so a shape probe in the wrong
+    order would maintain only the active copy and the standby's
+    history would never seal, roll up, or expire."""
+    from theia_tpu.store import (ReplicatedFlowDatabase,
+                                 ShardedFlowDatabase)
+    for db, n_concrete in (
+            (ShardedFlowDatabase(n_shards=2), 2),
+            (ReplicatedFlowDatabase(replicas=2), 2),
+            (ReplicatedFlowDatabase(
+                replicas=2,
+                factory=lambda: ShardedFlowDatabase(n_shards=2)), 4)):
+        loop = MetricsHistoryLoop(db, interval=15, node="t",
+                                  retention_seconds=0,
+                                  tiers=[(60, 60)])
+        for t in range(0, 90, 15):
+            assert loop.run_once(now=1000 + t) > 0
+        assert loop.failures == 0
+        concrete = history.concrete_metrics_tables(db)
+        assert len(concrete) == n_concrete
+        doc = QueryEngine(db).execute(parse_plan(
+            {"table": "__metrics__", "agg": "count"}),
+            use_cache=False)
+        assert doc["rows"][0]["count"] > 0
+        # maintenance visits every concrete copy
+        assert loop.maintain(now=100_000)["partsRolledUp"] >= 0
+
+
+def test_replicated_sharded_maintenance_reaches_standby():
+    """Retention on a replicated-of-sharded store must delete from the
+    STANDBY replica's shards too, or its copy diverges and grows
+    without bound until a failover serves it."""
+    from theia_tpu.store import (ReplicatedFlowDatabase,
+                                 ShardedFlowDatabase)
+    db = ReplicatedFlowDatabase(
+        replicas=2, factory=lambda: ShardedFlowDatabase(n_shards=2))
+    loop = MetricsHistoryLoop(db, interval=15, node="t",
+                              retention_seconds=100, tiers=[])
+    _insert(db, _series_rows("t", "old_total", [1, 2], t0=0))
+    loop.maintain(now=1000)
+    for replica in db.replicas:
+        for shard in replica.shards:
+            assert len(shard.result_tables["__metrics__"]) == 0
+
+
+# -- determinism under concurrent sharded ingest --------------------------
+
+
+def test_scrape_during_sharded_ingest_counters_monotone():
+    """4 producer threads hammer a 4-shard IngestManager while the
+    history loop scrapes concurrently: every stored cumulative series
+    must be MONOTONE non-decreasing (the striped-counter merge is
+    exact — a scrape can land between stripes' increments but can
+    never read a sum below an earlier sum), and the final stored
+    total matches the acked row count."""
+    db = FlowDatabase()
+    im = IngestManager(db, n_shards=4)
+    # the registry is process-global: earlier tests already moved the
+    # ingest counters, so the final-point check is a DELTA from here
+    base_rows = metrics.counter("theia_ingest_rows_total").value()
+    stop = threading.Event()
+    errors = []
+    acked = [0] * 4
+
+    def produce(tid):
+        enc = BlockEncoder()
+        try:
+            for b in range(8):
+                batch = generate_flows(SynthConfig(
+                    n_series=32, points_per_series=8,
+                    anomaly_fraction=0.0, seed=100 * tid + b))
+                out = im.ingest(enc.encode(batch),
+                                stream=f"mono{tid}")
+                acked[tid] += int(out["rows"])
+        except Exception as e:   # pragma: no cover
+            errors.append(e)
+
+    loop = MetricsHistoryLoop(db, interval=15, node="n1",
+                              retention_seconds=0, tiers=[])
+    ticks = [0]
+
+    def scraper():
+        t = 0
+        while not stop.is_set():
+            loop.run_once(now=1000 + t)
+            ticks[0] += 1
+            t += 15
+
+    threads = [threading.Thread(target=produce, args=(i,))
+               for i in range(4)]
+    s = threading.Thread(target=scraper)
+    s.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    stop.set()
+    s.join(timeout=30)
+    assert not errors
+    assert ticks[0] > 0
+    # one final tick AFTER all ingest retired: the last point must
+    # equal the exact acked total
+    loop.run_once(now=10_000_000)
+    data = history.metrics_table(db).scan()
+    names = data.strings("metric")
+    kinds = data.strings("kind")
+    times = np.asarray(data["timeInserted"])
+    vals = np.asarray(data["value"])
+    for metric in set(names.tolist()):
+        keep = (names == metric) & np.isin(
+            kinds, ("counter", "sum", "count", "bucket"))
+        if not keep.any():
+            continue
+        # per (labels) series monotone in time
+        labels = data.strings("labels")
+        for lab in set(labels[keep].tolist()):
+            k2 = keep & (labels == lab)
+            order = np.argsort(times[k2], kind="stable")
+            series = vals[k2][order]
+            assert (np.diff(series) >= 0).all(), (
+                f"non-monotone stored series {metric}{{{lab}}}")
+    rows_series = vals[(names == "theia_ingest_rows_total")]
+    assert rows_series[-1] == int(round(
+        (base_rows + sum(acked)) * SCALE))
+    im.close()
+
+
+# -- crash consistency ----------------------------------------------------
+
+
+def _segments(wal_dir):
+    return sorted(os.path.join(wal_dir, n) for n in os.listdir(wal_dir)
+                  if n.startswith("wal-") and n.endswith(".log"))
+
+
+@pytest.mark.wal
+def test_kill9_mid_scrape_recovery_gap_only(tmp_path):
+    """kill -9 mid-scrape (torn WAL tail): recovery leaves the
+    `__metrics__` table loadable and GAP-ONLY — each scrape tick is
+    one WAL record, so a tick is either fully present or fully
+    absent, never torn or double-counted — and the loop resumes on
+    the recovered store."""
+    wd = str(tmp_path / "w")
+    db = FlowDatabase()
+    db.attach_wal(wd, sync="always")
+    loop = MetricsHistoryLoop(db, interval=15, node="n1",
+                              retention_seconds=0, tiers=[])
+    for t in range(0, 90, 15):
+        loop.run_once(now=1000 + t)
+    per_tick = {}
+    data = history.metrics_table(db).scan()
+    for t in np.asarray(data["timeInserted"]).tolist():
+        per_tick[t] = per_tick.get(t, 0) + 1
+    db.close_wal()
+    # tear the tail: chop into the last record's payload
+    seg = _segments(wd)[-1]
+    with open(seg, "r+b") as f:
+        f.truncate(os.path.getsize(seg) - 37)
+    db2 = FlowDatabase()
+    stats = db2.attach_wal(wd)
+    assert stats["recoveredRows"] > 0
+    data2 = history.metrics_table(db2).scan()
+    per_tick2 = {}
+    for t in np.asarray(data2["timeInserted"]).tolist():
+        per_tick2[t] = per_tick2.get(t, 0) + 1
+    # gap-only: every recovered tick is COMPLETE (same row count as
+    # pre-crash) and no tick is duplicated; the torn tick is absent
+    for t, n in per_tick2.items():
+        assert per_tick[t] == n, f"tick {t} torn or double-counted"
+    assert 0 < len(per_tick2) < len(per_tick) + 1
+    # still queryable + the loop resumes
+    doc = QueryEngine(db2).execute(parse_plan(
+        {"table": "__metrics__", "agg": "count"}), use_cache=False)
+    assert doc["rows"][0]["count"] == len(data2)
+    loop2 = MetricsHistoryLoop(db2, interval=15, node="n1",
+                               retention_seconds=0, tiers=[])
+    assert loop2.run_once(now=2000) > 0
+    db2.close_wal()
+
+
+# -- slow-query ring (satellite regression) --------------------------------
+
+
+def test_slow_query_ring_carries_granule_stats(monkeypatch):
+    """Captured slow-query entries must surface the PR-12 granule
+    scanned/skipped stats at top level AND inside the profile."""
+    from theia_tpu.query.explain import SLOW_QUERIES
+    monkeypatch.setenv("THEIA_QUERY_SLOW_MS", "0.000001")
+    db = FlowDatabase(engine="parts")
+    db.insert_flows(generate_flows(SynthConfig(
+        n_series=64, points_per_series=20, anomaly_fraction=0.0,
+        seed=3)))
+    db.flows.seal()
+    SLOW_QUERIES.reset()
+    t = db.flows.scan()
+    lo = int(np.asarray(t["timeInserted"]).min())
+    doc = QueryEngine(db).execute(parse_plan(
+        {"groupBy": "destinationIP", "agg": "count",
+         "start": lo, "end": lo + 2}), use_cache=False)
+    entries = SLOW_QUERIES.snapshot()
+    assert entries, "query not captured (threshold armed)"
+    e = entries[0]
+    assert e["granulesScanned"] == doc["granulesScanned"]
+    assert e["granulesSkipped"] == doc["granulesSkipped"]
+    assert e["profile"]["granulesScanned"] == doc["granulesScanned"]
+    assert e["profile"]["granulesSkipped"] == doc["granulesSkipped"]
+    SLOW_QUERIES.reset()
+
+
+# -- rules engine ---------------------------------------------------------
+
+
+def _exec_for(db):
+    eng = QueryEngine(db)
+    return lambda doc: eng.execute(parse_plan(doc), use_cache=False)
+
+
+def test_rules_threshold_hysteresis_fire_and_resolve(tmp_path):
+    """Breach must hold for_ticks before firing and clear clear_ticks
+    before resolving; exactly two transitions land on the sink."""
+    db = FlowDatabase()
+    # gauge sits at 1, spikes to 9 for 3 ticks, then returns to 1
+    vals = [1, 1, 9, 9, 9, 1, 1, 1]
+    for i, v in enumerate(vals):
+        _insert(db, _series_rows("", "g_depth", [v], t0=i * 15,
+                                 kind="gauge"))
+    path = tmp_path / "rules.json"
+    # window=1: each evaluation sees exactly its own tick's sample
+    # (a wider window would straddle the previous tick and stretch
+    # the breach streak)
+    path.write_text(json.dumps([{
+        "name": "depth-high", "metric": "g_depth", "agg": "max",
+        "window": 1, "threshold": 5.0,
+        "for_ticks": 2, "clear_ticks": 2}]))
+    fired = []
+    eng = rules.RulesEngine(_exec_for(db), alert_sink=fired.append,
+                            path=str(path))
+    states = []
+    for i in range(len(vals)):
+        eng.evaluate(now=i * 15)
+        states.append(bool(eng.firing()))
+    # fires on the 2nd breached tick (i=3), resolves on the 2nd clear
+    # tick (i=6)
+    assert states == [False, False, False, True, True, True, False,
+                      False]
+    assert [a["state"] for a in fired] == ["firing", "resolved"]
+    assert fired[0]["rule"] == "depth-high"
+    assert fired[0]["value"] == pytest.approx(9.0)
+
+
+def test_rules_burn_rate_multiwindow_gate(tmp_path):
+    """A short-window spike alone must NOT fire a burn-rate rule;
+    sustained burn that breaches the long window too must — and the
+    per_node grouping names the burning node only."""
+    db = FlowDatabase()
+    # n-ok: flat. n-burn: counts 2/s sustained over the whole window
+    for node, slope in (("n-ok", 0.01), ("n-burn", 2.0)):
+        _insert(db, _series_rows(
+            node, "e_total",
+            [i * 15 * slope for i in range(41)], t0=0))
+    path = tmp_path / "rules.json"
+    path.write_text(json.dumps([{
+        "name": "burn", "type": "burn_rate", "metric": "e_total",
+        "per_node": True, "windows": [60, 600], "threshold": 1.0,
+        "for_ticks": 1, "clear_ticks": 1}]))
+    fired = []
+    eng = rules.RulesEngine(_exec_for(db), alert_sink=fired.append,
+                            path=str(path))
+    eng.evaluate(now=600)
+    firing = eng.firing()
+    assert [f["node"] for f in firing] == ["n-burn"]
+    assert fired and fired[0]["node"] == "n-burn"
+    # short-window-only spike on a third node: long window stays
+    # clear → no fire
+    spike = _series_rows("n-spike", "s_total",
+                         [0] * 36 + [0, 30, 60, 90, 120], t0=0)
+    _insert(db, spike)
+    path.write_text(json.dumps([{
+        "name": "spike", "type": "burn_rate", "metric": "s_total",
+        "per_node": True, "windows": [60, 600], "threshold": 1.0,
+        "for_ticks": 1, "clear_ticks": 1}]))
+    eng.reload(force=True)
+    fired.clear()
+    eng.evaluate(now=600)
+    # 120 increase over 60s = 2/s short, but 120/600 = 0.2/s long
+    assert not [f for f in eng.firing() if f["rule"] == "spike"]
+    assert not fired
+
+
+def test_rules_hot_reload_and_malformed_file_keeps_previous(tmp_path):
+    db = FlowDatabase()
+    _insert(db, _series_rows("", "g", [9], t0=0, kind="gauge"))
+    path = tmp_path / "rules.json"
+    path.write_text(json.dumps([{
+        "name": "a", "metric": "g", "agg": "max", "window": 60,
+        "threshold": 5, "for_ticks": 1, "clear_ticks": 1}]))
+    eng = rules.RulesEngine(_exec_for(db), path=str(path))
+    assert [r.name for r in eng.rules] == ["a"]
+    # rewrite with a second rule; bump mtime explicitly (same-second
+    # writes would otherwise be invisible)
+    path.write_text(json.dumps([
+        {"name": "a", "metric": "g", "agg": "max", "window": 60,
+         "threshold": 5},
+        {"name": "b", "metric": "g", "agg": "min", "window": 60,
+         "threshold": 1, "op": "<="}]))
+    os.utime(path, (time.time() + 5, time.time() + 5))
+    eng.evaluate(now=0)
+    assert [r.name for r in eng.rules] == ["a", "b"]
+    assert eng.load_error is None
+    # malformed file: previous set keeps evaluating, error surfaced
+    path.write_text("{not json")
+    os.utime(path, (time.time() + 10, time.time() + 10))
+    eng.evaluate(now=15)
+    assert [r.name for r in eng.rules] == ["a", "b"]
+    assert eng.load_error
+    doc = eng.doc()
+    assert doc["loadError"] and len(doc["rules"]) == 2
+    # a path unreadable from the VERY FIRST load surfaces too — a
+    # typo'd THEIA_ALERT_RULES must not yield a silently empty engine
+    missing = rules.RulesEngine(_exec_for(db),
+                                path=str(tmp_path / "nope.json"))
+    assert missing.rules == [] and missing.load_error
+    assert missing.doc()["loadError"]
+    # ...and clears once the file appears
+    (tmp_path / "nope.json").write_text(json.dumps([
+        {"name": "late", "metric": "g", "threshold": 5}]))
+    missing.evaluate(now=0)
+    assert [r.name for r in missing.rules] == ["late"]
+    assert missing.load_error is None
+    # rule validation rejects junk
+    with pytest.raises(rules.RuleError):
+        rules.parse_rules(json.dumps([{"name": "x"}]))
+    with pytest.raises(rules.RuleError):
+        rules.parse_rules(json.dumps(
+            [{"name": "x", "metric": "m", "threshold": 1,
+              "agg": "median"}]))
+    with pytest.raises(rules.RuleError):
+        rules.parse_rules(json.dumps(
+            [{"name": "x", "metric": "m", "threshold": 1},
+             {"name": "x", "metric": "m", "threshold": 2}]))
+
+
+def test_rules_failed_query_keeps_state(tmp_path):
+    """A store outage during evaluation must not mass-resolve firing
+    alerts (the evaluation errors, state freezes)."""
+    db = FlowDatabase()
+    _insert(db, _series_rows("", "g", [9], t0=0, kind="gauge"))
+    path = tmp_path / "rules.json"
+    path.write_text(json.dumps([{
+        "name": "a", "metric": "g", "agg": "max", "window": 60,
+        "threshold": 5, "for_ticks": 1, "clear_ticks": 1}]))
+    calls = {"fail": False}
+    real = _exec_for(db)
+
+    def execute(doc):
+        if calls["fail"]:
+            raise RuntimeError("store down")
+        return real(doc)
+
+    fired = []
+    eng = rules.RulesEngine(execute, alert_sink=fired.append,
+                            path=str(path))
+    eng.evaluate(now=0)
+    assert eng.firing()
+    calls["fail"] = True
+    eng.evaluate(now=15)
+    eng.evaluate(now=30)
+    assert eng.firing(), "outage must not resolve a firing alert"
+    assert [a["state"] for a in fired] == ["firing"]
+
+
+def test_rules_partial_result_keeps_state(tmp_path):
+    """A degraded fan-out (partial:true) drops the missing peer's
+    series — which must freeze rule state, not count as clear ticks
+    that resolve the alert on exactly the node in trouble."""
+    db = FlowDatabase()
+    _insert(db, _series_rows("n2", "g", [9], t0=0, kind="gauge"))
+    path = tmp_path / "rules.json"
+    path.write_text(json.dumps([{
+        "name": "a", "metric": "g", "per_node": True, "agg": "max",
+        "window": 60, "threshold": 5, "for_ticks": 1,
+        "clear_ticks": 1}]))
+    mode = {"partial": False}
+    real = _exec_for(db)
+
+    def execute(doc):
+        res = dict(real(doc))
+        if mode["partial"]:
+            res["partial"] = True
+            res["missingPeers"] = ["n2"]
+            res["rows"] = []   # the missing peer's series are gone
+        return res
+
+    fired = []
+    eng = rules.RulesEngine(execute, alert_sink=fired.append,
+                            path=str(path))
+    eng.evaluate(now=0)
+    assert [f["node"] for f in eng.firing()] == ["n2"]
+    mode["partial"] = True
+    eng.evaluate(now=15)
+    eng.evaluate(now=30)
+    assert eng.firing(), "partial result must not resolve the alert"
+    assert [a["state"] for a in fired] == ["firing"]
+
+
+# -- cluster-wide history queries ------------------------------------------
+
+
+def _free_port():
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait_until(cond, timeout=20.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+@pytest.mark.distquery
+def test_cluster_history_query_answers_from_any_node(monkeypatch):
+    """Routing-mesh acceptance slice: every node self-scrapes with its
+    own `node` stamp, and a `table=__metrics__` query on ANY node
+    scatter-gathers the whole cluster's stored series — plus the
+    node's rule engine (wired through the same coordinator) sees a
+    remote node's series."""
+    monkeypatch.setenv("THEIA_RETENTION_INTERVAL", "0")
+    monkeypatch.setenv("THEIA_CLUSTER_HEARTBEAT", "0.05")
+    monkeypatch.setenv("THEIA_CLUSTER_BOUNDS_INTERVAL", "0.02")
+    import urllib.request
+
+    from theia_tpu.manager.api import TheiaManagerServer
+    ports = [_free_port() for _ in range(2)]
+    peers = ",".join(
+        f"n{i}=http://127.0.0.1:{p}" for i, p in enumerate(ports))
+    servers = []
+    try:
+        for i in range(2):
+            srv = TheiaManagerServer(
+                FlowDatabase(), port=ports[i], cluster_peers=peers,
+                cluster_self=f"n{i}", cluster_role="peer")
+            srv.start_background()
+            servers.append(srv)
+        _wait_until(
+            lambda: all(s.cluster.cmap.is_alive(p)
+                        for s in servers
+                        for p in s.cluster.cmap.others()),
+            what="peers alive")
+        now = int(time.time())
+        for s in servers:
+            for t in range(0, 60, 15):
+                s.history.run_once(now=now - 60 + t)
+        raw = urllib.request.urlopen(
+            f"http://127.0.0.1:{ports[0]}/query?table=__metrics__"
+            f"&group_by=node&agg=count&k=0&cache=0",
+            timeout=30).read()
+        doc = json.loads(raw)
+        assert doc["engine"] == "cluster"
+        assert not doc.get("partial")
+        nodes = {r["node"] for r in doc["rows"]}
+        assert nodes == {"n0", "n1"}
+        # the rule engine on n0 evaluates THROUGH the coordinator:
+        # a per-node rule over a loop counter sees both nodes
+        vals = servers[0].rules._window_values(
+            rules.Rule({"name": "x", "per_node": True,
+                        "metric": "theia_metrics_history_rows_total",
+                        "threshold": 0}), 120, now)
+        assert set(vals) == {"n0", "n1"}
+    finally:
+        for s in servers:
+            s.shutdown()
+
+
+def test_cluster_cache_invalidates_on_remote_scrape(monkeypatch):
+    """Regression: the cluster result cache keys on the PLAN table's
+    heartbeat-piggybacked digest. A remote peer's scrape tick (which
+    never moves the flows fingerprint) must invalidate a cached
+    `table=__metrics__` result within one heartbeat — while the same
+    scrape churn leaves a cached flows result a HIT."""
+    monkeypatch.setenv("THEIA_RETENTION_INTERVAL", "0")
+    monkeypatch.setenv("THEIA_CLUSTER_HEARTBEAT", "0.05")
+    monkeypatch.setenv("THEIA_CLUSTER_BOUNDS_INTERVAL", "0.02")
+    # background loop constructed but never ticks inside the test
+    monkeypatch.setenv("THEIA_METRICS_SCRAPE_INTERVAL", "3600")
+    import urllib.request
+
+    from theia_tpu.manager.api import TheiaManagerServer
+    ports = [_free_port() for _ in range(2)]
+    peers = ",".join(
+        f"n{i}=http://127.0.0.1:{p}" for i, p in enumerate(ports))
+    servers = []
+
+    def query(doc):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{ports[0]}/query",
+            data=json.dumps(doc).encode(), method="POST")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.load(r)
+
+    try:
+        for i in range(2):
+            srv = TheiaManagerServer(
+                FlowDatabase(), port=ports[i], cluster_peers=peers,
+                cluster_self=f"n{i}", cluster_role="peer")
+            srv.start_background()
+            servers.append(srv)
+        _wait_until(
+            lambda: all(s.cluster.cmap.is_alive(p)
+                        for s in servers
+                        for p in s.cluster.cmap.others()),
+            what="peers alive")
+        now = int(time.time())
+        for s in servers:
+            s.history.run_once(now=now - 60)
+        mplan = {"table": "__metrics__", "groupBy": "node",
+                 "agg": "count", "k": 0}
+        _wait_until(
+            lambda: {r["node"] for r in query(mplan)["rows"]}
+            == {"n0", "n1"}, what="both nodes' series visible")
+        doc = query(mplan)
+        count0 = {r["node"]: r["count"] for r in doc["rows"]}
+        assert query(mplan)["cache"] == "hit"
+        # flows result cached on the coordinator, pre-scrape
+        fplan = {"groupBy": "destinationIP", "agg": "count", "k": 0}
+        query(fplan)
+        assert query(fplan)["cache"] == "hit"
+        # the REMOTE peer scrapes: its __metrics__ digest moves, its
+        # flows digest does not
+        servers[1].history.run_once(now=now - 30)
+        _wait_until(
+            lambda: {r["node"]: r["count"]
+                     for r in query(mplan)["rows"]}.get("n1", 0)
+            > count0["n1"],
+            what="remote scrape visible through the cluster cache")
+        assert query(fplan)["cache"] == "hit"
+    finally:
+        for s in servers:
+            s.shutdown()
+
+
+# -- jobs GC coexistence ---------------------------------------------------
+
+
+def test_job_gc_leaves_metrics_table_alone():
+    """gc_stale_results drops job rows with no live CR; the id-less
+    `__metrics__` table must be skipped, not emptied."""
+    from theia_tpu.manager.jobs import JobController
+    db = FlowDatabase()
+    ctl = JobController(db, workers=0)   # startup GC runs here
+    _insert(db, _series_rows("n1", "keep_total", [1, 2, 3]))
+    db.tadetector.insert_rows(
+        [{"id": "dead-job", "algoType": "EWMA", "anomaly": "[1.0]"}])
+    removed = ctl.gc_stale_results()
+    assert removed == 1
+    assert len(db.tadetector) == 0
+    assert len(history.metrics_table(db)) == 3
+    ctl.shutdown()
+
+
+# -- theia top --history bucket fold ---------------------------------------
+
+
+def test_history_series_keeps_trailing_samples():
+    """The sparkline fold queries [start, now] but buckets cover
+    n_buckets * bucket seconds, which is SHORTER whenever
+    window % bucket != 0 (and excludes t == now always); the trailing
+    remainder must fold into the final bucket — the LAST column is
+    the operator's "what is it right now", so silently dropping the
+    newest stored samples would show the pre-incident value during an
+    incident."""
+    from theia_tpu.cli.__main__ import _history_series
+    scale = 1_000_000
+    # window=100 → bucket=15, n_buckets=6, covered span [0, 90)
+    start, bucket, n_buckets = 0, 15, 6
+
+    def gauge_row(t, v):
+        return {"timeInserted": t, "metric": "g", "kind": "gauge",
+                "labels": "", "node": "n1",
+                "sum(valueSum)": int(v * scale), "sum(valueCount)": 1,
+                "min(valueMin)": int(v * scale),
+                "max(valueMax)": int(v * scale)}
+
+    rows = [gauge_row(0, 1.0), gauge_row(95, 7.0),
+            gauge_row(100, 9.0)]          # t=now lands past 6*15
+    series = _history_series(rows, start, bucket, n_buckets)
+    vals = series[("g", "gauge")]
+    assert len(vals) == n_buckets
+    assert vals[0] == 1.0
+    # both trailing samples pool into the final bucket's mean
+    assert vals[-1] == 8.0
+    # pre-window samples still drop
+    assert _history_series([gauge_row(-5, 3.0)], start, bucket,
+                           n_buckets) == {}

@@ -692,6 +692,8 @@ def query_cmd(args) -> None:
     against ANY node of a cluster, not just the one it was pointed
     at."""
     doc: dict = {}
+    if getattr(args, "table", ""):
+        doc["table"] = args.table
     if args.group_by:
         doc["groupBy"] = args.group_by
     if args.agg:
@@ -929,14 +931,20 @@ def _cluster_top_rows(samples, prev, dt):
             return default
         return sum(v for (n, _), v in sample.items() if n == name)
 
+    def skip_pct(scanned: float, skipped: float) -> str:
+        total = scanned + skipped
+        return f"{100.0 * skipped / total:,.0f}%" if total > 0 else "-"
+
     rows = []
-    totals = {"rows": 0.0, "parts": 0.0, "q": 0.0}
+    totals = {"rows": 0.0, "parts": 0.0, "q": 0.0,
+              "gscan": 0.0, "gskip": 0.0}
     for addr, sample in samples.items():
         prior = (prev or {}).get(addr)
         if sample is None:
             rows.append({"NODE": _node_label(addr),
                          "STATUS": "DOWN", "ROWS/s": "", "REPL LAG": "",
-                         "ADMISSION": "", "PARTS": "", "QUERY/s": ""})
+                         "ADMISSION": "", "PARTS": "", "QUERY/s": "",
+                         "GRAN SKIP": ""})
             continue
         rows_s = rate(sample, prior, "theia_ingest_rows_total")
         q_s = (rate(sample, prior, "theia_query_cache_hits_total")
@@ -946,9 +954,16 @@ def _cluster_top_rows(samples, prev, dt):
                 if n == "theia_repl_lag_records"]
         lvl = int(gauge(sample, "theia_admission_level"))
         parts = gauge(sample, "theia_store_parts")
+        # index effectiveness at a glance: lifetime share of index
+        # granules the skip indexes pruned inside scanned parts
+        # (theia_query_granules_*_total, PR 12)
+        gscan = gauge(sample, "theia_query_granules_scanned_total")
+        gskip = gauge(sample, "theia_query_granules_skipped_total")
         totals["rows"] += rows_s
         totals["parts"] += parts
         totals["q"] += q_s
+        totals["gscan"] += gscan
+        totals["gskip"] += gskip
         rows.append({
             "NODE": _node_label(addr),
             "STATUS": "up",
@@ -958,12 +973,14 @@ def _cluster_top_rows(samples, prev, dt):
                 min(max(lvl, 0), len(_ADMISSION_NAMES) - 1)],
             "PARTS": f"{parts:,.0f}",
             "QUERY/s": f"{q_s:,.1f}",
+            "GRAN SKIP": skip_pct(gscan, gskip),
         })
     rows.append({
         "NODE": "TOTAL", "STATUS": "",
         "ROWS/s": f"{totals['rows']:,.0f}", "REPL LAG": "",
         "ADMISSION": "", "PARTS": f"{totals['parts']:,.0f}",
         "QUERY/s": f"{totals['q']:,.1f}",
+        "GRAN SKIP": skip_pct(totals["gscan"], totals["gskip"]),
     })
     return rows
 
@@ -1018,7 +1035,8 @@ def top_cluster(args) -> None:
                     f"{p} {ms:.1f}ms" for p, ms in sorted(rtts)))
             _print_table(_cluster_top_rows(samples, prev, dt),
                          ["NODE", "STATUS", "ROWS/s", "REPL LAG",
-                          "ADMISSION", "PARTS", "QUERY/s"])
+                          "ADMISSION", "PARTS", "QUERY/s",
+                          "GRAN SKIP"])
             prev, prev_t = samples, now
             i += 1
             if args.iterations and i >= args.iterations:
@@ -1028,9 +1046,275 @@ def top_cluster(args) -> None:
         pass
 
 
+# -- stored-history sparklines (theia top --history) ---------------------
+
+_WINDOW_UNITS = {"s": 1, "m": 60, "h": 3600, "d": 86400}
+
+_SPARK_CHARS = "▁▂▃▄▅▆▇█"
+
+
+def _parse_window(raw: str) -> int:
+    """'6h' / '30m' / '900' → seconds."""
+    s = raw.strip().lower()
+    try:
+        if s and s[-1] in _WINDOW_UNITS:
+            return int(float(s[:-1]) * _WINDOW_UNITS[s[-1]])
+        return int(s)
+    except ValueError:
+        raise APIError(f"error: bad --history window {raw!r} "
+                       f"(expected e.g. 6h, 30m, 900)")
+
+
+def _sparkline(values) -> str:
+    """One row of block characters; None (empty bucket) renders as a
+    space; a flat series renders at the floor."""
+    finite = [v for v in values if v is not None]
+    if not finite:
+        return ""
+    lo, hi = min(finite), max(finite)
+    span = hi - lo
+    out = []
+    for v in values:
+        if v is None:
+            out.append(" ")
+        elif span <= 0:
+            out.append(_SPARK_CHARS[0])
+        else:
+            out.append(_SPARK_CHARS[
+                min(len(_SPARK_CHARS) - 1,
+                    int((v - lo) / span * len(_SPARK_CHARS)))])
+    return "".join(out)
+
+
+def _history_series(rows, start: int, bucket: int, n_buckets: int):
+    """Fold /query rows (metric, kind, labels, node, timeInserted +
+    the four exact aggregate columns) into per-(metric, kind) bucket
+    arrays of NATURAL-unit values: gauges → mean sample per bucket
+    (pooled across children); cumulative kinds (counters, histogram
+    sum/count) → rate/s computed PER SERIES — each labels × node
+    child is its own monotone counter, whose bucket-to-bucket level
+    increase is exact — then summed across the metric's series
+    (differencing a max folded over unrelated children would track
+    only the highest-level series and hide the rest)."""
+    scale = 1e6   # METRICS_VALUE_SCALE (kept literal: import-light)
+    gauge_acc: Dict[tuple, dict] = {}
+    cum_acc: Dict[tuple, dict] = {}
+    for r in rows:
+        b = (int(r["timeInserted"]) - start) // bucket
+        if b < 0:
+            continue
+        # the query window runs through `now` inclusive, which lands
+        # past the last bucket boundary whenever window % bucket != 0
+        # (and always for t == now); fold that remainder into the
+        # final bucket instead of silently dropping the newest
+        # samples — LAST must show the most recent stored value
+        b = min(b, n_buckets - 1)
+        if r["kind"] == "gauge":
+            s = gauge_acc.setdefault((r["metric"], r["kind"]), {})
+            cur = s.get(b)
+            if cur is None:
+                s[b] = {"sum": r["sum(valueSum)"],
+                        "count": r["sum(valueCount)"]}
+            else:
+                cur["sum"] += r["sum(valueSum)"]
+                cur["count"] += r["sum(valueCount)"]
+        else:
+            key = (r["metric"], r["kind"],
+                   r.get("labels", ""), r.get("node", ""))
+            s = cum_acc.setdefault(key, {})
+            cur = s.get(b)
+            if cur is None:
+                s[b] = {"max": r["max(valueMax)"]}
+            else:
+                cur["max"] = max(cur["max"], r["max(valueMax)"])
+    series: Dict[tuple, list] = {}
+    for (metric, kind), buckets in sorted(gauge_acc.items()):
+        vals: list = [None] * n_buckets
+        for b, v in buckets.items():
+            if v["count"]:
+                vals[b] = v["sum"] / scale / v["count"]
+        series[(metric, kind)] = vals
+    for (metric, kind, _lab, _node), buckets in sorted(
+            cum_acc.items()):
+        # this one series' rate between consecutive non-empty buckets
+        vals = [None] * n_buckets
+        prev_level = None
+        prev_b = None
+        for b in sorted(buckets):
+            level = buckets[b]["max"] / scale
+            if prev_level is not None and b > prev_b:
+                vals[b] = max(level - prev_level, 0.0) \
+                    / ((b - prev_b) * bucket)
+            prev_level, prev_b = level, b
+        out = series.get((metric, kind))
+        if out is None:
+            series[(metric, kind)] = vals
+        else:
+            for i, v in enumerate(vals):
+                if v is not None:
+                    out[i] = v + (out[i] or 0.0)
+    # derived mean series: where a histogram's _sum and _count rates
+    # both exist, their ratio is the mean observation per bucket —
+    # the "latency sparkline"
+    for (metric, kind) in list(series):
+        if kind != "sum" or not metric.endswith("_sum"):
+            continue
+        base = metric[:-4]
+        cnt = series.get((base + "_count", "count"))
+        if cnt is None:
+            continue
+        s_vals = series[(metric, kind)]
+        mean = [
+            (s_vals[i] / cnt[i])
+            if (s_vals[i] is not None and cnt[i]) else None
+            for i in range(n_buckets)]
+        series[(base + " (mean)", "derived")] = mean
+    return series
+
+
+def top_history(args) -> None:
+    """`theia top --history <window>`: render sparklines from the
+    STORED `__metrics__` series instead of diffing two live scrapes —
+    history survives restarts, and on a cluster the query plane
+    answers for every node from any node. Windows past the rollup
+    horizon read from downsampled parts transparently."""
+    window = _parse_window(args.history)
+    now = int(time.time())
+    start = now - window
+    # bucket floor = the default scrape cadence: narrower buckets
+    # would alias raw 15s samples into an on/off checkerboard
+    bucket = max(window // 48, 15)
+    n_buckets = max(window // bucket, 1)
+    filters = [{"column": "kind", "op": "ne", "value": "bucket"}]
+    if getattr(args, "node", ""):
+        filters.append({"column": "node", "op": "eq",
+                        "value": args.node})
+    doc = {"table": "__metrics__",
+           "groupBy": "metric,kind,labels,node,timeInserted",
+           "aggregates": ["min:valueMin", "max:valueMax",
+                          "sum:valueSum", "sum:valueCount"],
+           "filters": filters,
+           "start": start, "end": now + 1, "k": 0,
+           "cache": "0"}
+    from ..ingest.client import IngestClient, IngestError
+    addrs = [a.strip() for a in args.manager_addr.split(",")
+             if a.strip()]
+    try:
+        client = IngestClient(addrs, stream="cli-top",
+                              token=_TOKEN, ca_cert=_CA_CERT or None,
+                              max_attempts=4, backoff_base=0.2,
+                              backoff_cap=2.0)
+        out = client.request_json("POST", "/query", doc)
+    except IngestError as e:
+        raise APIError(f"error: {e}")
+    rows = out.get("rows", [])
+    needle = (getattr(args, "metric", "") or "").strip()
+    if needle:
+        rows = [r for r in rows if needle in r["metric"]]
+    series = _history_series(rows, start, bucket, n_buckets)
+    stamp = datetime.datetime.fromtimestamp(now).strftime(TIME_FORMAT)
+    print(f"theia top --history {args.history} — "
+          f"{len(series)} series, {bucket}s buckets, "
+          f"stored history through {stamp}")
+    if not series:
+        print("no stored series in the window (is the metrics "
+              "history loop on? THEIA_METRICS_SCRAPE_INTERVAL)")
+        return
+    table = []
+    for (metric, kind), vals in sorted(series.items()):
+        finite = [v for v in vals if v is not None]
+        last = finite[-1] if finite else None
+        unit = "/s" if kind not in ("gauge", "derived") else ""
+        table.append({
+            "METRIC": metric,
+            "KIND": kind,
+            "SPARK": _sparkline(vals),
+            "LAST": (f"{last:,.4g}{unit}"
+                     if last is not None else "-"),
+        })
+    _print_table(table, ["METRIC", "KIND", "SPARK", "LAST"])
+    if out.get("partial"):
+        print(f"!! PARTIAL history — peers unavailable: "
+              f"{', '.join(out.get('missingPeers', []))}",
+              file=sys.stderr)
+
+
+def alerts_cmd(args) -> None:
+    """`theia alerts [--rules]`: the recent alert ring (detector +
+    rule firings), and with --rules the declarative rule set with its
+    per-(rule, node) hysteresis states."""
+    doc = _request(args.manager_addr, "GET",
+                   f"/alerts?limit={args.limit}")
+    if args.json:
+        print(json.dumps(doc, indent=2))
+        return
+    if args.rules:
+        rules = doc.get("rules")
+        if not rules:
+            print("no alert rules engine on this manager (set "
+                  "THEIA_ALERT_RULES and keep "
+                  "THEIA_METRICS_SCRAPE_INTERVAL > 0)")
+            return
+        print(f"alert rules — {len(rules.get('rules', []))} loaded "
+              f"from {rules.get('path') or '(unset)'}, "
+              f"{rules.get('evaluations', 0)} evaluations, "
+              f"{rules.get('transitions', 0)} transitions")
+        if rules.get("loadError"):
+            print(f"!! load error (previous rule set still active): "
+                  f"{rules['loadError']}", file=sys.stderr)
+        spec_rows = [{
+            "RULE": r["name"], "TYPE": r["type"],
+            "METRIC": r["metric"],
+            "EXPR": f"{r['agg']} {r['op']} {r['threshold']:g}",
+            "WINDOWS": ",".join(str(w) for w in r["windows"]),
+            "FOR": f"{r['forTicks']}/{r['clearTicks']}",
+        } for r in rules.get("rules", [])]
+        if spec_rows:
+            _print_table(spec_rows, ["RULE", "TYPE", "METRIC",
+                                     "EXPR", "WINDOWS", "FOR"])
+        state_rows = [{
+            "RULE": s["rule"],
+            "NODE": s.get("node", ""),
+            "STATE": ("FIRING" if s["state"] == "firing"
+                      else s["state"]),
+            "VALUE": (f"{s['value']:,.4g}"
+                      if s.get("value") is not None else "-"),
+            "STREAK": s.get("breachStreak", 0),
+        } for s in rules.get("states", [])]
+        if state_rows:
+            _print_table(state_rows, ["RULE", "NODE", "STATE",
+                                      "VALUE", "STREAK"])
+        else:
+            print("(no rule states yet — waiting for the first "
+                  "evaluation ticks)")
+        return
+    alerts = doc.get("alerts") or []
+    if not alerts:
+        print("no recent alerts")
+        return
+    rows = [{
+        "TIME": (datetime.datetime.fromtimestamp(
+            a["time"]).strftime(TIME_FORMAT)
+            if a.get("time") else ""),
+        "KIND": a.get("kind", a.get("algo", "")),
+        "DETAIL": (f"rule {a.get('rule')} {a.get('state')} "
+                   f"value={a.get('value'):,.4g} vs "
+                   f"{a.get('op', '>=')} {a.get('threshold')}"
+                   if a.get("kind") == "rule"
+                   and a.get("value") is not None
+                   else str({k: v for k, v in a.items()
+                             if k not in ("time", "kind")})[:100]),
+        "NODE": a.get("node", ""),
+    } for a in alerts]
+    _print_table(rows, ["TIME", "KIND", "NODE", "DETAIL"])
+
+
 def top(args) -> None:
     """Poll GET /metrics and render a live rates table (rates are
     deltas between successive scrapes)."""
+    if getattr(args, "history", ""):
+        top_history(args)
+        return
     if getattr(args, "cluster", False):
         top_cluster(args)
         return
@@ -1482,6 +1766,9 @@ def build_parser() -> argparse.ArgumentParser:
         "query",
         help="filtered aggregation over the flow store (the "
              "vectorized /query read path)")
+    q.add_argument("--table", default="",
+                   help="table to query: flows (default) or "
+                        "__metrics__ (the stored metrics history)")
     q.add_argument("--group-by", default="",
                    help="comma-separated group-by columns "
                         "(e.g. sourceIP,destinationIP)")
@@ -1536,9 +1823,33 @@ def build_parser() -> argparse.ArgumentParser:
                     help="scrape EVERY endpoint in the (comma-"
                          "separated) --manager-addr list and render "
                          "per-node columns (rows/s, repl lag, "
-                         "admission rung, parts, query/s) plus a "
-                         "cluster-total row")
+                         "admission rung, parts, query/s, granule "
+                         "skip ratio) plus a cluster-total row")
+    tp.add_argument("--history", default="",
+                    help="render sparklines from the STORED metrics "
+                         "history (table __metrics__) over this "
+                         "trailing window (e.g. 6h, 30m) instead of "
+                         "diffing live scrapes")
+    tp.add_argument("--metric", default="",
+                    help="with --history: only series whose name "
+                         "contains this substring")
+    tp.add_argument("--node", default="",
+                    help="with --history: only series recorded by "
+                         "this node id")
     tp.set_defaults(fn=top)
+
+    al = sub.add_parser("alerts",
+                        help="recent alerts from the manager's ring "
+                             "(detector + rule firings); --rules "
+                             "shows the declarative rule set and "
+                             "its hysteresis states")
+    al.add_argument("--rules", action="store_true",
+                    help="show the alert-rule set + per-(rule, node) "
+                         "states instead of the alert ring")
+    al.add_argument("--limit", type=int, default=100)
+    al.add_argument("--json", action="store_true",
+                    help="print the raw /alerts document")
+    al.set_defaults(fn=alerts_cmd)
 
     tr = sub.add_parser("trace",
                         help="fetch one distributed trace by id from "

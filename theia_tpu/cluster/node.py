@@ -255,19 +255,33 @@ class ClusterNode:
         scan is throttled to THEIA_CLUSTER_BOUNDS_INTERVAL — while a
         store is actively changing inside the throttle window only
         the bare fingerprint ships, so stale-narrow bounds can never
-        wrongly prune this node."""
+        wrongly prune this node. Per-table digests ride alongside
+        (`tables`): coordinators key their cluster cache on the PLAN
+        table's digest, so a scrape tick moving this node's
+        `__metrics__` digest invalidates metrics-history results
+        within one heartbeat without churning the flows digest that
+        keys everything else."""
         try:
             fp = self.query_engine.fingerprint_hash()
+            tfp = self.query_engine.table_fingerprints()
         except Exception:
             return None   # e.g. every replica down: peers skip pruning
         cached = self._store_doc_cache
         if cached is not None and cached.get("fingerprint") == fp:
+            # bounds/rows describe the FLOWS tables only, so an
+            # unchanged flows fingerprint keeps them valid — a scrape
+            # tick refreshes just the per-table digest map instead of
+            # re-running the O(rows) bounds scan every interval
+            if cached.get("tables") != tfp:
+                cached = dict(cached)
+                cached["tables"] = tfp
+                self._store_doc_cache = cached
             return cached
         now = time.monotonic()
         if cached is not None and \
                 now - self._store_doc_at < self._bounds_interval:
-            return {"fingerprint": fp}
-        doc: Dict[str, object] = {"fingerprint": fp}
+            return {"fingerprint": fp, "tables": tfp}
+        doc: Dict[str, object] = {"fingerprint": fp, "tables": tfp}
         try:
             rows = 0
             tabs: List[Dict[str, tuple]] = []

@@ -203,6 +203,88 @@ class SupportBundleManager(AsyncCollector):
         return buf.getvalue()
 
 
+def refresh_scrape_gauges(controller, ingest, retention) -> None:
+    """Refresh the scrape-time gauges — state that is cheaper to read
+    on scrape than to maintain on every write. Shared by GET /metrics
+    and the metrics-history loop (obs/history.py), so the stored
+    series and the live exposition agree at every tick."""
+    db = controller.db
+    try:
+        _obs_metrics.gauge(
+            "theia_store_flow_rows",
+            "Current flow-table rows").set(len(db.flows))
+        _obs_metrics.gauge(
+            "theia_store_flow_bytes",
+            "Current flow-table column bytes").set(db.flows.nbytes)
+    except Exception:
+        # e.g. every replica down: the store gauges go stale but
+        # the rest of the registry must stay scrapeable — an
+        # outage is exactly when the jobs/replica/fault series
+        # matter most.
+        pass
+    health = controller.health()
+    _obs_metrics.gauge(
+        "theia_job_queue_depth",
+        "Jobs waiting for a worker").set(health["queueDepth"])
+    _obs_metrics.gauge(
+        "theia_jobs_running",
+        "Jobs currently executing").set(health["running"])
+    if ingest is not None:
+        live = ingest.shard_liveness()
+        _obs_metrics.gauge(
+            "theia_ingest_streams",
+            "Active ingest streams").set(live["streams"])
+        _obs_metrics.gauge(
+            "theia_detector_series",
+            "Tracked connection series across detector shards"
+        ).set(sum(s["series"] for s in live["perShard"]))
+        # Slot saturation pair: live vs capacity — read them with
+        # theia_detector_series_dropped_total, which counts the
+        # series silently turned away once every slot is taken.
+        _obs_metrics.gauge(
+            "theia_detector_series_capacity",
+            "Total streaming-detector slot capacity across shards"
+        ).set(sum(s.get("capacity", 0)
+                  for s in live["perShard"]))
+        _obs_metrics.gauge(
+            "theia_ingest_insert_inflight",
+            "Store-insert legs submitted but not finished (the "
+            "bounded insert backlog)").set(ingest.inflight_count())
+        adm = getattr(ingest, "admission", None)
+        if adm is not None:
+            # refresh theia_admission_level/_pressure at scrape
+            # time (and let an idle manager step the ladder down)
+            adm.evaluate()
+    if isinstance(db, ReplicatedFlowDatabase):
+        m = db.membership()
+        _obs_metrics.gauge(
+            "theia_replicas_live",
+            "Replicas currently serving").set(len(m["live"]))
+    if retention is not None:
+        _obs_metrics.gauge(
+            "theia_retention_usage_percent",
+            "Store bytes vs retention capacity").set(
+                retention.stats()["usagePercent"])
+    try:
+        # the getattr itself can raise on a replicated store with
+        # every replica down (__getattr__ resolves via `active`)
+        parts = db.store_stats().get("parts")
+    except Exception:
+        parts = None
+    if parts:
+        _obs_metrics.gauge(
+            "theia_store_parts",
+            "Sealed column parts in the flows table (parts "
+            "engine)").set(parts["count"])
+        pb = _obs_metrics.gauge(
+            "theia_store_part_bytes",
+            "Sealed-part bytes by tier: hot = resident "
+            "encoded chunks, cold = on-disk part files",
+            labelnames=("tier",))
+        pb.labels(tier="hot").set(parts["hotBytes"])
+        pb.labels(tier="cold").set(parts["coldBytes"])
+
+
 class ManagerAPIHandler(BaseHTTPRequestHandler):
     server_version = f"theia-tpu-manager/{__version__}"
     # HTTP/1.1: keep-alive, so the cluster transport's persistent
@@ -221,6 +303,8 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
     queries = None    # QueryEngine
     distqueries = None  # ClusterQueryCoordinator (routing mesh)
     cluster = None    # ClusterNode (multi-node tier)
+    history = None    # MetricsHistoryLoop (scrape-to-store series)
+    rules = None      # RulesEngine (alert rules over stored series)
     auth_token: Optional[str] = None
     quiet = True
     # Socket timeout (StreamRequestHandler honors it): a client that
@@ -426,10 +510,15 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
             # token (when configured) is required here too.
             self._require_auth()
             limit = int(self._query().get("limit", "100"))
-            self._send_json(
-                {"alerts": self.ingest.recent_alerts(limit),
-                 "rowsIngested": self.ingest.rows_ingested,
-                 "detectorShards": self.ingest.n_shards})
+            doc = {"alerts": self.ingest.recent_alerts(limit),
+                   "rowsIngested": self.ingest.rows_ingested,
+                   "detectorShards": self.ingest.n_shards}
+            rules = getattr(self, "rules", None)
+            if rules is not None:
+                # declarative alert-rule states (obs/rules.py) ride
+                # the same surface their firings land on
+                doc["rules"] = rules.doc()
+            self._send_json(doc)
             return
         if parts == ("metrics",):
             # Prometheus exposition. Latency histograms and trace
@@ -521,84 +610,10 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
 
     def _send_metrics(self) -> None:
         """Render the process registry, refreshing the scrape-time
-        gauges first (state that is cheaper to read on scrape than to
-        maintain on every write)."""
-        db = self.controller.db
-        try:
-            _obs_metrics.gauge(
-                "theia_store_flow_rows",
-                "Current flow-table rows").set(len(db.flows))
-            _obs_metrics.gauge(
-                "theia_store_flow_bytes",
-                "Current flow-table column bytes").set(db.flows.nbytes)
-        except Exception:
-            # e.g. every replica down: the store gauges go stale but
-            # the rest of the registry must stay scrapeable — an
-            # outage is exactly when the jobs/replica/fault series
-            # matter most.
-            pass
-        health = self.controller.health()
-        _obs_metrics.gauge(
-            "theia_job_queue_depth",
-            "Jobs waiting for a worker").set(health["queueDepth"])
-        _obs_metrics.gauge(
-            "theia_jobs_running",
-            "Jobs currently executing").set(health["running"])
-        if self.ingest is not None:
-            live = self.ingest.shard_liveness()
-            _obs_metrics.gauge(
-                "theia_ingest_streams",
-                "Active ingest streams").set(live["streams"])
-            _obs_metrics.gauge(
-                "theia_detector_series",
-                "Tracked connection series across detector shards"
-            ).set(sum(s["series"] for s in live["perShard"]))
-            # Slot saturation pair: live vs capacity — read them with
-            # theia_detector_series_dropped_total, which counts the
-            # series silently turned away once every slot is taken.
-            _obs_metrics.gauge(
-                "theia_detector_series_capacity",
-                "Total streaming-detector slot capacity across shards"
-            ).set(sum(s.get("capacity", 0)
-                      for s in live["perShard"]))
-            _obs_metrics.gauge(
-                "theia_ingest_insert_inflight",
-                "Store-insert legs submitted but not finished (the "
-                "bounded insert backlog)").set(
-                    self.ingest.inflight_count())
-            adm = getattr(self.ingest, "admission", None)
-            if adm is not None:
-                # refresh theia_admission_level/_pressure at scrape
-                # time (and let an idle manager step the ladder down)
-                adm.evaluate()
-        if isinstance(db, ReplicatedFlowDatabase):
-            m = db.membership()
-            _obs_metrics.gauge(
-                "theia_replicas_live",
-                "Replicas currently serving").set(len(m["live"]))
-        if self.retention is not None:
-            _obs_metrics.gauge(
-                "theia_retention_usage_percent",
-                "Store bytes vs retention capacity").set(
-                    self.retention.stats()["usagePercent"])
-        try:
-            # the getattr itself can raise on a replicated store with
-            # every replica down (__getattr__ resolves via `active`)
-            parts = db.store_stats().get("parts")
-        except Exception:
-            parts = None
-        if parts:
-            _obs_metrics.gauge(
-                "theia_store_parts",
-                "Sealed column parts in the flows table (parts "
-                "engine)").set(parts["count"])
-            pb = _obs_metrics.gauge(
-                "theia_store_part_bytes",
-                "Sealed-part bytes by tier: hot = resident "
-                "encoded chunks, cold = on-disk part files",
-                labelnames=("tier",))
-            pb.labels(tier="hot").set(parts["hotBytes"])
-            pb.labels(tier="cold").set(parts["coldBytes"])
+        gauges first (shared with the metrics-history loop so both
+        surfaces agree at the tick)."""
+        refresh_scrape_gauges(self.controller, self.ingest,
+                              self.retention)
         raw = _obs_prom.render().encode()
         self.send_response(200)
         self.send_header("Content-Type", _obs_prom.CONTENT_TYPE)
@@ -667,6 +682,16 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
                 doc["status"] = "degraded"
         if self.retention is not None:
             doc["retention"] = self.retention.stats()
+        # Metrics-history loop: scrape cadence, stored rows, rollup/
+        # retention totals, failures — plus the rule engine's firing
+        # count (the detail lives on GET /alerts).
+        history = getattr(self, "history", None)
+        if history is not None:
+            hdoc = history.stats()
+            rules = getattr(self, "rules", None)
+            if rules is not None:
+                hdoc["rulesFiring"] = len(rules.firing())
+            doc["metricsHistory"] = hdoc
         # Query engine: executed count, worker/cold-buffer sizing,
         # kernel in use, and result-cache occupancy/hit counters.
         # (getattr like `maintenance` below: stub handler objects in
@@ -1325,6 +1350,37 @@ class TheiaManagerServer:
                     "replLag", self.cluster.repl_lag,
                     _env_int("THEIA_REPL_LAG_HIGH", 10_000))
 
+        # Metrics history: the scrape-to-store loop (obs/history.py)
+        # snapshots the process registry into the parts-backed
+        # `__metrics__` table on a cadence, downsamples/expires it,
+        # and drives the declarative alert rules (obs/rules.py) over
+        # the stored series THROUGH the same engine /query serves —
+        # cluster-wide on a routing mesh. A non-positive
+        # THEIA_METRICS_SCRAPE_INTERVAL disables the whole plane.
+        # Constructed here, STARTED after the socket bind.
+        self.history = None
+        self.rules = None
+        from ..obs.history import MetricsHistoryLoop, scrape_interval
+        if scrape_interval() > 0:
+            from ..obs.rules import RulesEngine
+            from ..query import parse_plan
+
+            rules_engine = (self.distqueries if self.distqueries
+                            is not None else self.queries)
+            self.rules = RulesEngine(
+                lambda doc: rules_engine.execute(
+                    parse_plan(doc), use_cache=False),
+                alert_sink=self.ingest.push_alert)
+            self.history = MetricsHistoryLoop(
+                db,
+                node=(self.cluster.cmap.self_id
+                      if self.cluster is not None else ""),
+                refresh=lambda: refresh_scrape_gauges(
+                    self.controller, self.ingest, self.retention),
+                accepts_writes=(self.cluster.accepts_ingest
+                                if self.cluster is not None else None),
+                rules=self.rules)
+
         handler = type("BoundHandler", (ManagerAPIHandler,), {
             "controller": self.controller,
             "stats": self.stats,
@@ -1336,6 +1392,8 @@ class TheiaManagerServer:
             "queries": self.queries,
             "distqueries": self.distqueries,
             "cluster": self.cluster,
+            "history": self.history,
+            "rules": self.rules,
             "auth_token": self.auth_token,
         })
         self.httpd = _TLSCapableServer((address, port), handler)
@@ -1371,6 +1429,8 @@ class TheiaManagerServer:
         if self.cluster is not None:
             # after the socket bind: peers probe us back immediately
             self.cluster.start()
+        if self.history is not None:
+            self.history.start()
         self._thread: Optional[threading.Thread] = None
         self._serving = False
 
@@ -1393,6 +1453,8 @@ class TheiaManagerServer:
         self.httpd.server_close()
         if self.repairer is not None:
             self.repairer.stop()
+        if self.history is not None:
+            self.history.stop()
         if self.retention is not None:
             self.retention.stop()
         if self.maintenance is not None:

@@ -304,6 +304,11 @@ class JobController:
             live = {r.job_id for r in self._records.values()}
         removed = 0
         for table in self.db.result_tables.values():
+            if not any(c.name == "id" for c in table.schema):
+                # not a job-results table (the `__metrics__` history
+                # table rides result_tables for WAL/replication but
+                # has no job id — its own retention owns deletion)
+                continue
             # value-based delete: identical logical rows can sit in
             # different physical orders across shards/replicas, so a
             # positional mask would be wrong there

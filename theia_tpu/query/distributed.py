@@ -43,7 +43,10 @@ plan, local store fingerprint, membership epoch, per-peer store
 fingerprints from the last heartbeat) — any peer's seal/merge/insert
 moves its fingerprint and invalidates structurally within one
 heartbeat; a peer going down or coming back bumps the membership
-epoch. Partial results are never cached.
+epoch. Partial results are never cached. Fingerprints are per PLAN
+TABLE (heartbeats piggyback a per-table digest map): a scrape tick
+moving a peer's `__metrics__` digest invalidates cached history
+results without churning the flows caches.
 
 **Degraded modes are first-class.** A down peer (no heartbeat inside
 the liveness timeout) or a peer whose fan-out request fails/times out
@@ -81,7 +84,7 @@ from .engine import (
     merge_materialized,
 )
 from .explain import SLOW_QUERIES, QueryProfiler
-from .plan import QueryPlan
+from .plan import QUERYABLE_TABLES, QueryPlan
 from .result import empty_result, finalize, lower_specs
 
 logger = get_logger("query.distributed")
@@ -135,13 +138,18 @@ def strict_mode() -> bool:
 def pack_partial(meta: Dict[str, object], plan: QueryPlan,
                  keys: Optional[List[np.ndarray]],
                  aggs: Optional[Dict[str, np.ndarray]],
-                 schema=FLOW_SCHEMA) -> bytes:
+                 schema=None) -> bytes:
     """Serialize one node's partial: envelope meta + a WAL record body
     carrying the materialized group-key columns and one int64 column
     per LOWERED aggregate label. Self-contained — string keys ship
     their unique strings, so the coordinator decodes without any
-    shared dictionary state."""
+    shared dictionary state. The schema defaults to the PLAN table's
+    (a `__metrics__` plan groups by metric/labels/node/kind — string
+    columns the flows schema doesn't know)."""
     from ..store.wal import encode_record_body
+    if schema is None:
+        schema = QUERYABLE_TABLES.get(plan.table,
+                                      (FLOW_SCHEMA,))[0]
     specs = lower_specs(plan)
     string_cols = {c.name for c in schema if c.is_string}
     cols: Dict[str, np.ndarray] = {}
@@ -206,12 +214,29 @@ def partial_from_batch(plan: QueryPlan, batch: ColumnarBatch
 
 # -- peer pruning ----------------------------------------------------------
 
+def _peer_table_fp(store_doc: Dict[str, object],
+                   table: str) -> Optional[str]:
+    """The digest a coordinator keys one peer's state on for a plan
+    over `table`: the heartbeat's per-table digest when the peer
+    ships one, else the legacy whole-store (flows) fingerprint —
+    'maybe stale' beats 'never invalidates', and a peer reporting
+    neither keeps the result uncacheable (the store guard)."""
+    tables = store_doc.get("tables")
+    if isinstance(tables, dict) and tables.get(table):
+        return tables[table]
+    return store_doc.get("fingerprint")
+
+
 def peer_excluded(plan: QueryPlan,
                   store_doc: Optional[Dict[str, object]]) -> bool:
     """True when a peer's heartbeat-reported store state PROVES it can
     contribute nothing: zero rows, or time bounds that cannot overlap
     the plan's half-open window. Missing/partial state means 'maybe'
-    — the peer is queried, never wrongly skipped."""
+    — the peer is queried, never wrongly skipped. Heartbeat bounds
+    and row counts describe the FLOWS tables only, so plans over any
+    other table (`__metrics__`) never prune a peer here."""
+    if plan.table != "flows":
+        return False
     if not store_doc:
         return False
     if store_doc.get("rows") == 0:
@@ -286,9 +311,16 @@ class ClusterQueryCoordinator:
         candidates = [p for p in others if p not in pruned]
         live = [p for p in candidates if self.cmap.is_alive(p)]
         down = [p for p in candidates if p not in live]
-        local_fp = self.engine.fingerprint()
+        # fingerprints cover the PLAN's table set: the flows digest
+        # never moves on a scrape tick, and the `__metrics__` digest
+        # (heartbeat-piggybacked per table) moves on every one — so
+        # cached history results invalidate within one heartbeat
+        # while flows caches ignore the scrape churn entirely
+        local_fp = self.engine.fingerprint(
+            self.engine._tables(plan.table))
         key = (plan.normalized(), local_fp, epoch,
-               tuple(sorted((p, peer_store[p].get("fingerprint"))
+               tuple(sorted((p, _peer_table_fp(peer_store[p],
+                                               plan.table))
                             for p in others)))
         caching = use_cache and self.cache.max_bytes > 0
         if caching:
@@ -429,7 +461,8 @@ class ClusterQueryCoordinator:
         # fingerprint could change under an unchanged key — and never
         # the profile (a later hit would serve a stale per-peer story)
         if caching and not missing and all(
-                peer_store[p].get("fingerprint") for p in others):
+                _peer_table_fp(peer_store[p], plan.table)
+                for p in others):
             self.cache.store(key, doc)
             doc = dict(doc)
         QueryEngine._stamp_trace(doc)   # before slow capture
@@ -506,7 +539,9 @@ def serve_partial(engine, plan: QueryPlan,
     keys, aggs = engine.execute_partial(plan, stats)
     _M_PARTIALS_SERVED.inc()
     meta: Dict[str, object] = {"node": node_id, **stats,
-                               "fingerprint": engine.fingerprint_hash(),
+                               "fingerprint": engine.fingerprint_hash(
+                                   engine.fingerprint(
+                                       engine._tables(plan.table))),
                                "execMs": round(
                                    (time.perf_counter() - t0) * 1000,
                                    3)}

@@ -78,7 +78,7 @@ from ..utils.logging import get_logger
 from ..utils.pool import get_pool
 from . import kernels
 from .explain import SLOW_QUERIES, QueryProfiler
-from .plan import QueryPlan
+from .plan import QUERYABLE_TABLES, QueryPlan
 from .reference import filter_mask, materialize_keys, reference_partial
 from .result import empty_result, finalize, lower_specs, value_columns
 
@@ -391,15 +391,25 @@ class QueryEngine:
 
     # -- store resolution --------------------------------------------------
 
-    def _tables(self) -> List[object]:
-        """Concrete flow tables to query: one for plain/replicated
-        (the active replica resolves through __getattr__ — all
-        replicas down raises, surfacing as 503), every shard for a
-        sharded store."""
-        flows = self.db.flows
-        if hasattr(flows, "tables"):
-            return list(flows.tables)
-        return [flows]
+    def _tables(self, table: str = "flows") -> List[object]:
+        """Concrete tables to query for one plan's target: one for
+        plain/replicated (the active replica resolves through
+        __getattr__ — all replicas down raises, surfacing as 503),
+        every shard for a sharded store. `flows` is the data plane;
+        any other name resolves through the store's result-table
+        registry (the `__metrics__` history table queries through
+        the same engine)."""
+        if table == "flows":
+            root = self.db.flows
+        else:
+            try:
+                root = self.db.result_tables[table]
+            except (KeyError, AttributeError):
+                raise QueryError(
+                    f"table {table!r} is not present in this store")
+        if hasattr(root, "tables"):
+            return list(root.tables)
+        return [root]
 
     @staticmethod
     def _table_state(table) -> tuple:
@@ -415,12 +425,33 @@ class QueryEngine:
 
     def fingerprint(self, tables: Optional[List[object]] = None
                     ) -> tuple:
-        """Cache-key component covering the whole store state; pass
+        """Cache-key component covering one table set's state; pass
         `tables` to fingerprint an already-resolved snapshot (execute
-        does — key and execution must cover the same table set)."""
+        does — key and execution must cover the same table set). The
+        default covers the FLOWS tables only — the `__metrics__`
+        history mutates every scrape tick, so folding it in here
+        would invalidate every flows cache (and re-trigger heartbeat
+        bounds scans) each tick; per-table digests come from
+        `table_fingerprints()`."""
         if tables is None:
             tables = self._tables()
         return tuple(self._table_state(t) for t in tables)
+
+    def table_fingerprints(self) -> Dict[str, str]:
+        """{table: digest} for every queryable table present in this
+        store — what cluster heartbeats piggyback, so a coordinator
+        keys its cache PER PLAN TABLE: a peer's scrape tick moves its
+        `__metrics__` digest (invalidating metrics-history results
+        within one heartbeat) without touching the flows digest that
+        keys everything else."""
+        out: Dict[str, str] = {}
+        for name in QUERYABLE_TABLES:
+            try:
+                tables = self._tables(name)
+            except Exception:
+                continue   # a store predating the table
+            out[name] = self.fingerprint_hash(self.fingerprint(tables))
+        return out
 
     def fingerprint_hash(self, fingerprint: Optional[tuple] = None
                          ) -> str:
@@ -468,7 +499,7 @@ class QueryEngine:
         with self._lock:
             self.queries += 1
         t0 = time.perf_counter()
-        tables = self._tables()
+        tables = self._tables(plan.table)
         fp = self.fingerprint(tables)
         # a disabled cache (THEIA_QUERY_CACHE_BYTES=0) reports "off",
         # not a permanent 0% hit ratio that reads as a broken cache —
@@ -585,8 +616,8 @@ class QueryEngine:
                      "granulesSkipped": 0}
         for k in ("granulesScanned", "granulesSkipped"):
             stats.setdefault(k, 0)
-        return self._partial_for_tables(plan, self._tables(), stats,
-                                        prof)
+        return self._partial_for_tables(plan, self._tables(plan.table),
+                                        stats, prof)
 
     # -- per-table execution -----------------------------------------------
 
@@ -781,7 +812,8 @@ class QueryEngine:
                     len(rows_sel) if rows_sel is not None else p.rows)
             if prof is not None:
                 prof.add_part(p.uid, p.tier, p.rows, pruned=reason,
-                              granules=gdetail)
+                              granules=gdetail,
+                              resolution=p.minmax.get("resolution"))
         partials: List[Partial] = []
         if live:
             stripes = [live[i::self.workers]

@@ -87,14 +87,19 @@ class QueryProfiler:
 
     def add_part(self, uid: object, tier: str, rows: int,
                  pruned: Optional[str] = None,
-                 granules: Optional[Dict[str, object]] = None) -> None:
+                 granules: Optional[Dict[str, object]] = None,
+                 resolution=None) -> None:
         """One part's fate: scanned, or pruned with the reason
         (`time_window`, `range:<col>`, `codes:<col>`, or `granules`
         when every index granule proved empty). `granules` carries the
         intra-part skip-index story for a sorted part — {"scanned",
         "skipped", "reasons": {"pk:<col>"|"skip_minmax:<col>"|
         "skip_set:<col>": granule count}} — exactly as the engine
-        decided it (engine._granule_prune)."""
+        decided it (engine._granule_prune). `resolution` is the
+        part's (min, max) `resolution` metadata when the table tracks
+        one (`__metrics__`): a 6h window answered from downsampled
+        history shows rollup-tier parts (e.g. 3600) here, not raw
+        scrape points."""
         if len(self.parts) >= MAX_PROFILE_PARTS:
             self.parts_truncated += 1
             return
@@ -106,6 +111,9 @@ class QueryProfiler:
             entry["scanned"] = True
         if granules is not None:
             entry["granules"] = granules
+        if resolution is not None:
+            lo, hi = int(resolution[0]), int(resolution[1])
+            entry["resolution"] = lo if lo == hi else [lo, hi]
         self.parts.append(entry)
 
     def add_matched(self, n: int) -> None:
@@ -170,6 +178,11 @@ class SlowQueryLog:
             "rowsScanned": doc.get("rowsScanned"),
             "partsScanned": doc.get("partsScanned"),
             "partsPruned": doc.get("partsPruned"),
+            # the PR-12 granule skip-index story rides every capture:
+            # "slow despite skipping?" / "slow because nothing
+            # skipped?" is the first question a profile answers
+            "granulesScanned": doc.get("granulesScanned"),
+            "granulesSkipped": doc.get("granulesSkipped"),
             "profile": profile,
         }
         if doc.get("traceId"):

@@ -23,7 +23,19 @@ import hashlib
 import json
 from typing import Dict, List, Optional, Tuple
 
-from ..schema import FLOW_SCHEMA
+from ..schema import FLOW_SCHEMA, METRICS_SCHEMA, METRICS_TABLE
+
+#: queryable tables: name → (schema, default window-start column,
+#: default window-end column). `flows` is the data plane;
+#: `__metrics__` is the self-scraped metrics history (obs/history.py)
+#: — its rows are point-in-time samples, so both window columns
+#: default to the sample time (a half-open [start, end) window over
+#: `timeInserted`), and the same plan grammar that answers Grafana-
+#: shaped flow queries answers "p95 ingest latency, last 6h".
+QUERYABLE_TABLES: Dict[str, tuple] = {
+    "flows": (FLOW_SCHEMA, "flowStartSeconds", "flowEndSeconds"),
+    METRICS_TABLE: (METRICS_SCHEMA, "timeInserted", "timeInserted"),
+}
 
 #: filter operators, canonical spelling → accepted aliases
 _OP_ALIASES = {
@@ -84,7 +96,8 @@ class Aggregate:
 
 @dataclasses.dataclass(frozen=True)
 class QueryPlan:
-    """A validated, normalized query over the flows table."""
+    """A validated, normalized query over one queryable table
+    (`flows`, or the `__metrics__` history table)."""
 
     group_by: Tuple[str, ...]
     aggregates: Tuple[Aggregate, ...]
@@ -95,6 +108,7 @@ class QueryPlan:
     end_column: str
     k: int
     order_by: str            # an aggregate label
+    table: str = "flows"
 
     # -- normalization -----------------------------------------------------
 
@@ -103,6 +117,7 @@ class QueryPlan:
         defaults) — the cache key substrate and the doc echoed back to
         API clients."""
         return {
+            "table": self.table,
             "groupBy": list(self.group_by),
             "aggregates": [a.to_doc() for a in self.aggregates],
             "filters": sorted((f.to_doc() for f in self.filters),
@@ -209,12 +224,23 @@ def _parse_aggregate(doc, schema) -> Aggregate:
     return Aggregate(op, str(column))
 
 
-def parse_plan(doc: Dict[str, object], schema=FLOW_SCHEMA) -> QueryPlan:
+def parse_plan(doc: Dict[str, object], schema=None) -> QueryPlan:
     """Build a validated QueryPlan from a request body (or any dict in
     the same shape). Raises PlanError (a ValueError → HTTP 400) on
-    anything malformed."""
+    anything malformed. The plan's `table` (default `flows`) picks the
+    schema every column resolves against and the window-column
+    defaults; an explicit `schema` argument overrides (tests querying
+    synthetic tables)."""
     if not isinstance(doc, dict):
         raise PlanError("query body must be a JSON object")
+    table = str(doc.get("table") or "flows")
+    default_time, default_end = "flowStartSeconds", "flowEndSeconds"
+    if schema is None:
+        if table not in QUERYABLE_TABLES:
+            raise PlanError(
+                f"unknown table {table!r} (expected one of "
+                f"{sorted(QUERYABLE_TABLES)})")
+        schema, default_time, default_end = QUERYABLE_TABLES[table]
     group_by = doc.get("groupBy") or []
     if isinstance(group_by, str):
         group_by = [g for g in group_by.split(",") if g]
@@ -246,8 +272,8 @@ def parse_plan(doc: Dict[str, object], schema=FLOW_SCHEMA) -> QueryPlan:
             raise PlanError(f"{key} must be an integer, got {v!r}")
 
     start, end = _opt_int("start"), _opt_int("end")
-    time_column = str(doc.get("timeColumn") or "flowStartSeconds")
-    end_column = str(doc.get("endColumn") or "flowEndSeconds")
+    time_column = str(doc.get("timeColumn") or default_time)
+    end_column = str(doc.get("endColumn") or default_end)
     for name in (time_column, end_column):
         if _schema_column(schema, name).is_string:
             # the window compares integers; a dictionary column here
@@ -272,18 +298,21 @@ def parse_plan(doc: Dict[str, object], schema=FLOW_SCHEMA) -> QueryPlan:
         filters=filters,
         start=start, end=end,
         time_column=time_column, end_column=end_column,
-        k=int(k), order_by=order_by)
+        k=int(k), order_by=order_by, table=table)
 
 
 def plan_from_params(params: Dict[str, str],
-                     schema=FLOW_SCHEMA) -> QueryPlan:
+                     schema=None) -> QueryPlan:
     """GET /query adapter: flat query-string params → plan doc.
 
-    `group_by=a,b` · `agg=sum:col,count` · `start`/`end` ·
+    `table=flows|__metrics__` · `group_by=a,b` ·
+    `agg=sum:col,count` · `start`/`end` ·
     `time_column`/`end_column` · `k` · `order_by` ·
     `where=col:op:value;col2:op:v1|v2` (values for `in` joined
     with `|`)."""
     doc: Dict[str, object] = {}
+    if params.get("table"):
+        doc["table"] = params["table"]
     if params.get("group_by"):
         doc["groupBy"] = params["group_by"]
     if params.get("agg"):

@@ -227,3 +227,39 @@ SPATIALNOISE_SCHEMA: tuple = _cols(
     ("destinationTransportPort", K.U16),
     ("octetDeltaCount", K.U64),
 )
+
+#: the one authoritative name of the self-scraped metrics history
+#: table — the store registers it, the planner resolves it, and the
+#: scrape loop writes it, all from this constant
+METRICS_TABLE = "__metrics__"
+
+#: scale factor for metric values stored in the `__metrics__` table:
+#: the query plane aggregates in exact int64, so float samples
+#: (histogram sums in seconds, fractional gauges) are stored as
+#: micro-units — `round(value * 1e6)` — and consumers divide back.
+METRICS_VALUE_SCALE = 1_000_000
+
+# The `__metrics__` table: the process's own Prometheus registry as
+# stored time series (the role Grafana-over-ClickHouse history plays
+# in the reference — dashboards query the store, never live scrapes).
+# One row per series sample per scrape tick, Prometheus exposition
+# naming: counters under their declared name, histograms as
+# `<name>_bucket` (le in `labels`) / `<name>_sum` / `<name>_count`.
+# Rows at coarser `resolution` are the downsampler's rollups: `value`
+# is the LAST sample in the bucket (cumulative counters stay exact),
+# and valueMin/Max/Sum/Count fold the raw samples exactly, so
+# min/max/sum/count aggregations over a window are bit-identical
+# whether they scan raw 15s points or rollup parts.
+METRICS_SCHEMA: tuple = _cols(
+    ("timeInserted", K.DATETIME),   # sample (bucket-start) time
+    ("metric", K.STRING),           # exposition series name
+    ("labels", K.STRING),           # sorted `k=v,k=v` (incl. `le`)
+    ("node", K.STRING),             # recording node id ('' standalone)
+    ("kind", K.STRING),             # counter|gauge|sum|count|bucket
+    ("resolution", K.U64),          # seconds per sample bucket
+    ("value", K.U64),               # last sample, micro-units
+    ("valueMin", K.U64),            # exact folds over the raw samples
+    ("valueMax", K.U64),
+    ("valueSum", K.U64),
+    ("valueCount", K.U64),
+)

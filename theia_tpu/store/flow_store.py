@@ -34,6 +34,8 @@ from ..schema import (
     DROPDETECTION_SCHEMA,
     FLOW_SCHEMA,
     FLOWPATTERNS_SCHEMA,
+    METRICS_SCHEMA,
+    METRICS_TABLE,
     RECOMMENDATIONS_SCHEMA,
     SPATIALNOISE_SCHEMA,
     TADETECTOR_SCHEMA,
@@ -43,13 +45,17 @@ from ..schema import (
 )
 
 #: analytics result tables, in declaration order — the single list the
-#: store, sharded facade, stats, persistence, and job GC iterate
+#: store, sharded facade, stats, persistence, and job GC iterate.
+#: `__metrics__` rides it so the WAL hooks, snapshots, replication
+#: fan-out, sharded facade, and resync all cover stored metrics
+#: history for free.
 RESULT_TABLE_SCHEMAS = (
     ("tadetector", TADETECTOR_SCHEMA),
     ("recommendations", RECOMMENDATIONS_SCHEMA),
     ("dropdetection", DROPDETECTION_SCHEMA),
     ("flowpatterns", FLOWPATTERNS_SCHEMA),
     ("spatialnoise", SPATIALNOISE_SCHEMA),
+    (METRICS_TABLE, METRICS_SCHEMA),
 )
 from ..obs import metrics as _metrics
 from ..utils.backoff import capped_backoff
@@ -798,7 +804,8 @@ class FlowDatabase:
             self.flows = Table("flows", FLOW_SCHEMA)
             self._ingest_latch = None
         self.result_tables: Dict[str, Table] = {
-            name: Table(name, schema)
+            name: (self._make_metrics_table()
+                   if name == METRICS_TABLE else Table(name, schema))
             for name, schema in RESULT_TABLE_SCHEMAS}
         self.tadetector = self.result_tables["tadetector"]
         self.recommendations = self.result_tables["recommendations"]
@@ -820,6 +827,22 @@ class FlowDatabase:
         #: these so a producer retrying across a crash stays
         #: exactly-once
         self._recovered_acks: List[tuple] = []
+
+    @staticmethod
+    def _make_metrics_table():
+        """The `__metrics__` history table: parts-backed REGARDLESS of
+        the flows engine (sealed sorted parts are what make windowed
+        history queries prune and the downsampler's tier surgery
+        atomic), memory-resident (no directory — durability rides the
+        WAL + snapshot like every result table), sorted
+        time,metric,labels with `resolution` in the per-part min/max
+        so rollup tiers prune and EXPLAIN can name them."""
+        from .parts import PartTable
+        return PartTable(
+            METRICS_TABLE, METRICS_SCHEMA,
+            sort_key=("timeInserted", "metric", "labels"),
+            time_column="timeInserted",
+            prune_columns=("timeInserted", "resolution"))
 
     # -- ingest ------------------------------------------------------------
 

@@ -16,7 +16,9 @@ Schema history (mirrors the reference's column evolution):
   v4 — + `dropdetection` result table    (traffic-drop detection)
   v5 — + `tadetector.refitEvery`         (ARIMA refit-cadence audit)
   v6 — + `flowpatterns`, `spatialnoise`  (pattern mining + spatial
-        DBSCAN result tables; current)
+        DBSCAN result tables)
+  v7 — + `__metrics__` result table      (self-scraped metrics
+        history; current)
 """
 
 from __future__ import annotations
@@ -26,7 +28,7 @@ from typing import Callable, Dict, List
 
 import numpy as np
 
-CURRENT_SCHEMA_VERSION = 6
+CURRENT_SCHEMA_VERSION = 7
 VERSION_KEY = "__schema_version__"
 
 # framework version → schema version (reference VERSION_MAP,
@@ -38,6 +40,7 @@ VERSION_MAP = {
     "0.3.0": 4,
     "0.4.0": 5,
     "0.5.0": 6,
+    "0.6.0": 7,
 }
 
 Payload = Dict[str, np.ndarray]
@@ -103,6 +106,10 @@ MIGRATIONS: List[Migration] = [
                       _add_empty_table(p, "spatialnoise")) and None,
         down=lambda p: (_drop_table(p, "flowpatterns"),
                         _drop_table(p, "spatialnoise")) and None),
+    Migration(
+        version=7, name="add_metrics_history_table",
+        up=lambda p: _add_empty_table(p, "__metrics__"),
+        down=lambda p: _drop_table(p, "__metrics__")),
 ]
 
 

@@ -606,7 +606,8 @@ class PartTable(Table):
                  partition_seconds: Optional[int] = None,
                  time_column: str = "timeInserted",
                  sort_key: Optional[object] = None,
-                 granule_rows: Optional[int] = None) -> None:
+                 granule_rows: Optional[int] = None,
+                 prune_columns: Optional[Sequence[str]] = None) -> None:
         super().__init__(name, schema)
         # part primary key: None → env default; "" / () disables
         # sorting (format v1, the pre-PR-12 layout). Columns the
@@ -644,8 +645,13 @@ class PartTable(Table):
             if partition_seconds is None else int(partition_seconds)))
         self.part_time_column = (time_column if any(
             c.name == time_column for c in schema) else None)
+        # per-part min/max metadata columns: the flow defaults, or a
+        # caller-supplied set (the `__metrics__` table tracks
+        # `resolution` so queries prune rollup tiers and EXPLAIN can
+        # name them); always intersected with the schema
         self._prune_columns = tuple(
-            c for c in PRUNE_COLUMNS
+            c for c in (PRUNE_COLUMNS if prune_columns is None
+                        else tuple(prune_columns))
             if any(col.name == c for col in schema))
         #: sealed parts, strict insertion order; the memtable
         #: (self._batches, inherited) holds the unsealed tail
@@ -865,6 +871,52 @@ class PartTable(Table):
         """Force-seal the memtable (tests, bench)."""
         with self._lock:
             self._seal_locked()
+
+    # -- external part surgery ---------------------------------------------
+
+    def sealed_parts(self) -> List[Part]:
+        """Point-in-time snapshot of the sealed-part list (the parts
+        themselves are immutable). The public face for out-of-package
+        maintenance (the metrics-history downsampler, obs/history.py)
+        — part internals may move; this list and `replace_parts` are
+        the contract."""
+        with self._lock:
+            return list(self._parts)
+
+    def replace_parts(self, old: Sequence[Part],
+                      rows: Sequence[Dict[str, object]]) -> bool:
+        """Atomically swap the `old` sealed parts for ONE new part
+        built from `rows` (row dicts in natural value space; empty →
+        the old parts are simply dropped). This keeps the
+        part-mutation invariants — build outside the lock, swap +
+        generation bump under it, abort when a concurrent
+        merge/demote already replaced any of `old` — IN this class,
+        next to the merge/upgrade paths that share them. Readers are
+        never caught between states: they see the old parts or the
+        new one, never neither. Returns False on the concurrent-
+        mutation abort (the caller retries against fresh state)."""
+        new_part = None
+        if rows:
+            adopted = ColumnarBatch.from_rows(list(rows), self.schema,
+                                              self.dicts)
+            # fileless: an aborted swap must not leave an orphaned,
+            # permanently-guarded part file behind — the published
+            # part's file is materialized by snapshot/maintenance
+            # outside the lock, like every hot rewrite product
+            new_part = self._build_part(adopted, write_file=False)
+        drop = set(map(id, old))
+        with self._lock:
+            present = {id(p) for p in self._parts}
+            if not drop <= present:
+                return False
+            self._parts = [p for p in self._parts
+                           if id(p) not in drop]
+            if new_part is not None:
+                self._parts.append(new_part)
+            self.generation += 1
+            for p in old:
+                self._retire_file(p)
+        return True
 
     # -- decode ------------------------------------------------------------
 
