@@ -832,7 +832,12 @@ def test_cluster_cache_invalidates_on_remote_scrape(monkeypatch):
             == {"n0", "n1"}, what="both nodes' series visible")
         doc = query(mplan)
         count0 = {r["node"]: r["count"] for r in doc["rows"]}
-        assert query(mplan)["cache"] == "hit"
+        # the cache key includes each peer's heartbeat-piggybacked
+        # digest; a piggyback that lags the manual run_once can land
+        # BETWEEN two adjacent queries and legitimately miss once —
+        # wait for the settled state (stable digests → stable hits)
+        _wait_until(lambda: query(mplan)["cache"] == "hit",
+                    what="metrics result cached under settled digests")
         # flows result cached on the coordinator, pre-scrape
         fplan = {"groupBy": "destinationIP", "agg": "count", "k": 0}
         query(fplan)

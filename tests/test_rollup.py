@@ -1,0 +1,701 @@
+"""Streaming materialized rollup views (query/rollup.py).
+
+The acceptance matrix for the planner rewrite: rollup-served,
+raw-scan, and reference-oracle results are bit-identical on
+randomized subsumed plans (including stitched unaligned edges, with
+deletes/TTL/tier-folds/demotion interleaved), locally and through a
+3-node scatter-gather; the crash matrix (WAL replay re-derivation
+without double counting, torn config keeping the previous set,
+replication converging follower rollup answers); the legacy-MV parity
+(built-in default views group-for-group equal to ViewTable.scan, and
+the dashboard routing flag's assert mode); and the operator surface
+(/debug/views + theia views)."""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from theia_tpu.data.synth import SynthConfig, generate_flows
+from theia_tpu.query import QueryEngine, parse_plan
+from theia_tpu.query import rollup as ru
+from theia_tpu.query.reference import reference_execute
+from theia_tpu.schema import ColumnarBatch
+from theia_tpu.store import FlowDatabase, ShardedFlowDatabase
+
+pytestmark = pytest.mark.rollup
+
+T0 = 1_000_000
+
+
+def _write_views(path, views) -> str:
+    path.write_text(json.dumps({"views": views}))
+    return str(path)
+
+
+VIEW_PLAIN = {
+    "name": "per_pair",
+    "groupBy": ["sourceIP", "destinationIP"],
+    "aggregates": ["count", "sum:octetDeltaCount", "max:throughput",
+                   "min:throughput", "mean:throughput"],
+    "bucketSeconds": 60,
+    "tiers": [{"resolutionSeconds": 600, "afterSeconds": 1200},
+              {"resolutionSeconds": 3600, "afterSeconds": 7200}],
+}
+VIEW_FILTERED = {
+    "name": "allowed_only",
+    "groupBy": ["sourceIP", "destinationTransportPort"],
+    "aggregates": ["count", "sum:octetDeltaCount"],
+    "filters": [{"column": "ingressNetworkPolicyRuleAction",
+                 "op": "eq", "value": 1}],
+    "bucketSeconds": 60,
+    "tiers": [{"resolutionSeconds": 3600, "afterSeconds": 3600}],
+}
+
+
+def _flows_batch(seed: int, lo: int, hi: int,
+                 n_series: int = 32) -> ColumnarBatch:
+    """Synthetic flows with timeInserted spread over [lo, hi)."""
+    b = generate_flows(SynthConfig(
+        n_series=n_series, points_per_series=16,
+        anomaly_fraction=0.05, seed=seed))
+    rng = np.random.default_rng(seed + 1)
+    cols = dict(b.columns)
+    cols["timeInserted"] = np.sort(
+        rng.integers(lo, hi, len(b))).astype(np.int64)
+    return ColumnarBatch(cols, b.dicts)
+
+
+def _mk_db(monkeypatch, tmp_path, views, engine="parts",
+           defaults=False, **db_kw) -> FlowDatabase:
+    if views is not None:
+        monkeypatch.setenv("THEIA_ROLLUP_VIEWS", _write_views(
+            tmp_path / "views.json", views))
+    monkeypatch.setenv("THEIA_ROLLUP_DEFAULTS",
+                       "1" if defaults else "0")
+    monkeypatch.setenv("THEIA_STORE_MEMTABLE_ROWS", "256")
+    return FlowDatabase(engine=engine, **db_kw)
+
+
+def _assert_parity(engine, plan, expect_rollup=True, oracle_db=None):
+    """rollup-served == raw-scan (== reference oracle) rows. With
+    expect_rollup=None the rewrite may legitimately decline (e.g. a
+    window narrower than one aligned bucket after a fold) — parity is
+    still asserted; returns the doc either way."""
+    doc_r = engine.execute(plan, use_cache=False)
+    doc_raw = engine.execute(plan, use_cache=False, use_rollup=False)
+    assert "rollup" not in doc_raw
+    if expect_rollup:
+        assert doc_r.get("rollup"), \
+            f"plan not rollup-served: {plan.to_doc()}"
+    assert doc_r["rows"] == doc_raw["rows"]
+    assert doc_r["groupCount"] == doc_raw["groupCount"]
+    if oracle_db is not None:
+        rows, groups, _ = reference_execute(
+            plan, oracle_db.flows.scan(), oracle_db.flows.dicts)
+        assert doc_raw["rows"] == rows
+        assert doc_raw["groupCount"] == groups
+    return doc_r
+
+
+# -- config ----------------------------------------------------------------
+
+def test_view_config_validation():
+    with pytest.raises(ru.RollupConfigError):
+        ru.parse_view({"name": "x", "groupBy": ["nope"]})
+    with pytest.raises(ru.RollupConfigError):
+        ru.parse_view({"name": "bad name!", "groupBy": ["sourceIP"]})
+    with pytest.raises(ru.RollupConfigError):
+        ru.parse_view({"name": "x", "groupBy": ["sourceIP"],
+                       "bucketSeconds": 0})
+    with pytest.raises(ru.RollupConfigError):
+        # tier must be an ascending multiple of the previous
+        ru.parse_view({"name": "x", "groupBy": ["sourceIP"],
+                       "bucketSeconds": 60,
+                       "tiers": [{"resolutionSeconds": 90,
+                                  "afterSeconds": 10}]})
+    with pytest.raises(ru.RollupConfigError):
+        # only timeInserted buckets can track TTL trims exactly
+        ru.parse_view({"name": "x", "groupBy": ["sourceIP"],
+                       "timeColumn": "flowEndSeconds"})
+    with pytest.raises(ru.RollupConfigError):
+        # string columns cannot be aggregated
+        ru.parse_view({"name": "x", "groupBy": ["sourceIP"],
+                       "aggregates": ["sum:destinationIP"]})
+    v = ru.parse_view(VIEW_PLAIN)
+    # mean lowered to sum+count, deduplicated against explicit specs
+    assert ("count", "count", None) in [
+        (label, op, col) for label, op, col in v.specs]
+    assert all(op != "mean" for _, op, _ in v.specs)
+
+
+def test_defaults_merge_and_disable(monkeypatch, tmp_path):
+    monkeypatch.setenv("THEIA_ROLLUP_DEFAULTS", "1")
+    cfg = _write_views(tmp_path / "v.json", [
+        {"name": "flows_node_view", "disabled": True},
+        VIEW_PLAIN,
+    ])
+    monkeypatch.setenv("THEIA_ROLLUP_VIEWS", cfg)
+    db = FlowDatabase()
+    names = set(db.rollups.views)
+    assert "per_pair" in names
+    assert "flows_pod_view" in names and "flows_policy_view" in names
+    assert "flows_node_view" not in names
+
+
+# -- planner-rewrite parity (the acceptance gate) --------------------------
+
+def test_randomized_subsumed_plan_parity(monkeypatch, tmp_path):
+    """Randomized subsumed plans answer bit-identically from rollup
+    tiers and raw scans (and the reference oracle), with unaligned
+    windows, residual filters, tier folds, TTL deletes, and cold
+    demotion interleaved."""
+    db = _mk_db(monkeypatch, tmp_path, [VIEW_PLAIN, VIEW_FILTERED],
+                parts_dir=str(tmp_path / "parts"))
+    end = T0
+    for i in range(6):
+        db.insert_flows(_flows_batch(i, T0 + i * 3600,
+                                     T0 + (i + 1) * 3600))
+        end = T0 + (i + 1) * 3600
+    db.flows.seal()
+    eng = QueryEngine(db)
+    rng = np.random.default_rng(7)
+    group_pool = (["sourceIP"], ["destinationIP"],
+                  ["sourceIP", "destinationIP"], [])
+    aggs_pool = (["count"], ["sum:octetDeltaCount", "count"],
+                 ["max:throughput", "min:throughput"],
+                 ["mean:throughput"],
+                 ["count", "sum:octetDeltaCount", "mean:throughput"])
+
+    def random_plan():
+        doc = {
+            "groupBy": ",".join(group_pool[rng.integers(
+                len(group_pool))]),
+            "agg": list(aggs_pool[rng.integers(len(aggs_pool))]),
+            "timeColumn": "timeInserted",
+            "endColumn": "timeInserted", "k": 0,
+        }
+        if rng.random() < 0.8:
+            a, b = sorted(rng.integers(T0 - 100, end + 100, 2))
+            if a < b:
+                doc["start"], doc["end"] = int(a), int(b)
+        if rng.random() < 0.4:
+            doc["filters"] = [{
+                "column": "sourceIP", "op": "ne",
+                "value": "10.0.0.1"}]
+        return parse_plan(doc)
+
+    for _ in range(8):
+        _assert_parity(eng, random_plan(), expect_rollup=True,
+                       oracle_db=db)
+    # fold the older half into coarser tiers, then re-check: a plan
+    # whose window is narrower than the new (coarser) alignment may
+    # legitimately decline the rewrite — parity must hold regardless,
+    # and wide/unwindowed plans must still be served
+    assert db.rollups.maintain(now=end + 1) > 0
+    served = 0
+    for _ in range(6):
+        doc = _assert_parity(eng, random_plan(), expect_rollup=None,
+                             oracle_db=db)
+        served += bool(doc.get("rollup"))
+    assert served, "no randomized plan rollup-served after folding"
+    # TTL-style trim at an UNALIGNED boundary (straddling buckets
+    # re-derive from survivors), plus cold demotion of raw parts
+    db.delete_flows_older_than(T0 + 3600 + 1234)
+    db.flows.demote_oldest(0)
+    served = 0
+    for _ in range(6):
+        doc = _assert_parity(eng, random_plan(), expect_rollup=None,
+                             oracle_db=db)
+        served += bool(doc.get("rollup"))
+    assert served
+    # the filtered view: plan carrying the view's filter verbatim
+    plan = parse_plan({
+        "groupBy": "sourceIP",
+        "agg": ["count", "sum:octetDeltaCount"],
+        "filters": [{"column": "ingressNetworkPolicyRuleAction",
+                     "op": "eq", "value": 1}],
+        "start": T0 + 3700, "end": end - 55,
+        "timeColumn": "timeInserted", "endColumn": "timeInserted",
+        "k": 0})
+    doc = _assert_parity(eng, plan, expect_rollup=None, oracle_db=db)
+    if doc.get("rollup"):
+        assert doc["rollup"]["view"] in ("per_pair", "allowed_only")
+
+
+def test_stitched_edges_and_tier_reporting(monkeypatch, tmp_path):
+    db = _mk_db(monkeypatch, tmp_path, [VIEW_PLAIN])
+    for i in range(4):
+        db.insert_flows(_flows_batch(i, T0 + i * 3600,
+                                     T0 + (i + 1) * 3600))
+    db.flows.seal()
+    db.rollups.maintain(now=T0 + 4 * 3600 + 7200)
+    eng = QueryEngine(db)
+    plan = parse_plan({
+        "groupBy": "sourceIP", "agg": "sum:octetDeltaCount",
+        "start": T0 + 17, "end": T0 + 4 * 3600 - 23,
+        "timeColumn": "timeInserted", "endColumn": "timeInserted",
+        "k": 0})
+    doc = _assert_parity(eng, plan)
+    info = doc["rollup"]
+    assert info["view"] == "per_pair"
+    # after the cascade the coarsest present tier aligns the window
+    assert info["alignment"] == 3600
+    assert info["middle"][0] % 3600 == 0
+    assert info["middle"][1] % 3600 == 0
+    assert len(info["edges"]) == 2
+    assert info["edges"][0][0] == T0 + 17
+    assert info["edges"][1][1] == T0 + 4 * 3600 - 23
+    # rollup served far fewer rows than the raw scan
+    raw = eng.execute(plan, use_cache=False, use_rollup=False)
+    assert doc["rowsScanned"] < raw["rowsScanned"]
+    # EXPLAIN carries the rewrite story + rollup part resolutions
+    ex = eng.execute(plan, use_cache=False, explain=True)
+    assert ex["profile"]["rollup"]["view"] == "per_pair"
+    res = [p.get("resolution") for p in ex["profile"]["parts"]
+           if p.get("resolution") is not None]
+    assert res, "no rollup-tier parts named in the profile"
+
+
+def test_subsumption_declines_correctly(monkeypatch, tmp_path):
+    db = _mk_db(monkeypatch, tmp_path, [VIEW_PLAIN])
+    db.insert_flows(_flows_batch(0, T0, T0 + 3600))
+    db.flows.seal()
+    eng = QueryEngine(db)
+
+    def not_served(doc):
+        plan = parse_plan(doc)
+        out = eng.execute(plan, use_cache=False)
+        assert "rollup" not in out
+        return out
+
+    # group column outside the view
+    not_served({"groupBy": "sourceNodeName", "agg": "count", "k": 0})
+    # aggregate the view lacks
+    not_served({"groupBy": "sourceIP",
+                "agg": "sum:reverseThroughput", "k": 0})
+    # window on a column the view does not bucket
+    not_served({"groupBy": "sourceIP", "agg": "count",
+                "start": T0, "end": T0 + 600, "k": 0})
+    # residual filter outside the group columns
+    not_served({"groupBy": "sourceIP", "agg": "count",
+                "filters": [{"column": "sourceNodeName", "op": "eq",
+                             "value": "node-1"}], "k": 0})
+    # window narrower than one aligned bucket declines (pure raw)
+    short = parse_plan({"groupBy": "sourceIP", "agg": "count",
+                        "start": T0 + 5, "end": T0 + 20,
+                        "timeColumn": "timeInserted",
+                        "endColumn": "timeInserted", "k": 0})
+    out = eng.execute(short, use_cache=False)
+    assert "rollup" not in out
+    # whole-table (no window) IS served
+    allp = parse_plan({"groupBy": "sourceIP", "agg": "count",
+                       "k": 0})
+    _assert_parity(eng, allp, expect_rollup=True)
+    # per-request opt-out
+    raw = eng.execute(allp, use_cache=False, use_rollup=False)
+    assert raw["rows"] == eng.execute(allp, use_cache=False)["rows"]
+
+
+def test_execute_partial_rewrites_per_peer(monkeypatch, tmp_path):
+    """The distributed server half applies the rewrite too: partials
+    are identical with far fewer rows scanned."""
+    db = _mk_db(monkeypatch, tmp_path, [VIEW_PLAIN])
+    for i in range(3):
+        db.insert_flows(_flows_batch(i, T0 + i * 3600,
+                                     T0 + (i + 1) * 3600))
+    db.flows.seal()
+    eng = QueryEngine(db)
+    plan = parse_plan({"groupBy": "sourceIP",
+                       "agg": ["count", "sum:octetDeltaCount"],
+                       "start": T0, "end": T0 + 3 * 3600,
+                       "timeColumn": "timeInserted",
+                       "endColumn": "timeInserted", "k": 0})
+    s1 = {"rowsScanned": 0, "partsScanned": 0, "partsPruned": 0}
+    k1, a1 = eng.execute_partial(plan, s1)
+    s2 = {"rowsScanned": 0, "partsScanned": 0, "partsPruned": 0}
+    k2, a2 = eng.execute_partial(plan, s2, use_rollup=False)
+    assert s1["rowsScanned"] < s2["rowsScanned"]
+
+    def as_map(keys, aggs):
+        labels = sorted(aggs)
+        return {tuple(str(k[i]) for k in keys):
+                tuple(int(aggs[lb][i]) for lb in labels)
+                for i in range(len(aggs[labels[0]]))}
+
+    assert as_map(k1, a1) == as_map(k2, a2)
+
+
+# -- legacy-MV parity (built-in defaults + dashboards) ---------------------
+
+def test_default_views_match_legacy_viewtable(monkeypatch, tmp_path):
+    db = _mk_db(monkeypatch, tmp_path, None, defaults=True)
+    for i in range(3):
+        db.insert_flows(_flows_batch(i, T0 + i * 600,
+                                     T0 + (i + 1) * 600))
+    db.flows.seal()
+    db.rollups.maintain(now=T0 + 4000)
+    for name in ("flows_pod_view", "flows_node_view",
+                 "flows_policy_view"):
+        batch = ru.view_scan_batch(db, name)
+        assert batch is not None
+        # raises on any group/sum divergence
+        ru.assert_view_parity(batch, db.views[name].scan(), name)
+
+
+def test_dashboard_rollup_flag_with_parity_assert(monkeypatch,
+                                                  tmp_path):
+    from theia_tpu.dashboards import queries as dq
+    db = _mk_db(monkeypatch, tmp_path, None, defaults=True)
+    db.insert_flows(_flows_batch(1, T0, T0 + 1200, n_series=24))
+    db.flows.seal()
+    legacy = {name: fn(db) for name, fn in (
+        ("pod_to_pod", dq.pod_to_pod),
+        ("node_to_node", dq.node_to_node),
+        ("networkpolicy", dq.networkpolicy))}
+    monkeypatch.setenv("THEIA_DASHBOARD_ROLLUP", "assert")
+    routed = {name: fn(db) for name, fn in (
+        ("pod_to_pod", dq.pod_to_pod),
+        ("node_to_node", dq.node_to_node),
+        ("networkpolicy", dq.networkpolicy))}
+    for name in legacy:
+        assert routed[name] == legacy[name], name
+    # undeclared view falls back to legacy instead of failing
+    monkeypatch.setenv("THEIA_ROLLUP_DEFAULTS", "0")
+    db2 = FlowDatabase()
+    db2.insert_flows(_flows_batch(2, T0, T0 + 600, n_series=8))
+    assert dq.pod_to_pod(db2)  # legacy path, no rollup view declared
+
+
+# -- crash matrix ----------------------------------------------------------
+
+def test_wal_replay_rederives_without_double_count(monkeypatch,
+                                                   tmp_path):
+    """kill -9 between flows journal and rollup apply: replay re-runs
+    the insert path and re-derives identical rollup state — never
+    twice. Snapshot + WAL-tail recovery splits exactly at the
+    stamp."""
+    db = _mk_db(monkeypatch, tmp_path, [VIEW_PLAIN],
+                parts_dir=str(tmp_path / "p1"))
+    db.attach_wal(str(tmp_path / "w"), sync="always")
+    db.insert_flows(_flows_batch(0, T0, T0 + 3600))
+    db.flows.seal()
+    snap = str(tmp_path / "db.npz")
+    db.save(snap)
+    db.insert_flows(_flows_batch(1, T0 + 3600, T0 + 7200))
+    db.wal_sync()
+    eng = QueryEngine(db)
+    plan = parse_plan({"groupBy": "sourceIP",
+                       "agg": ["count", "sum:octetDeltaCount"],
+                       "k": 0})
+    expected = eng.execute(plan, use_cache=False,
+                           use_rollup=False)["rows"]
+    # crash: no final save, no clean close
+    db2 = FlowDatabase.load(snap, parts_dir=str(tmp_path / "p1"))
+    db2.attach_wal(str(tmp_path / "w"))
+    eng2 = QueryEngine(db2)
+    doc_r = eng2.execute(plan, use_cache=False)
+    doc_raw = eng2.execute(plan, use_cache=False, use_rollup=False)
+    assert doc_r.get("rollup")
+    assert doc_r["rows"] == expected
+    assert doc_raw["rows"] == expected
+    db2.close_wal()
+    db.close_wal()
+
+
+def test_snapshot_definition_drift_rebuilds(monkeypatch, tmp_path):
+    db = _mk_db(monkeypatch, tmp_path, [VIEW_PLAIN],
+                parts_dir=str(tmp_path / "p2"))
+    db.insert_flows(_flows_batch(3, T0, T0 + 3600))
+    db.flows.seal()
+    snap = str(tmp_path / "d.npz")
+    db.save(snap)
+    # same name, different definition → restore must rebuild
+    changed = dict(VIEW_PLAIN)
+    changed["groupBy"] = ["sourceIP"]
+    monkeypatch.setenv("THEIA_ROLLUP_VIEWS", _write_views(
+        tmp_path / "v2.json", [changed]))
+    db2 = FlowDatabase.load(snap, parts_dir=str(tmp_path / "p2"))
+    assert db2.rollups.rebuilds >= 1
+    eng2 = QueryEngine(db2)
+    plan = parse_plan({"groupBy": "sourceIP", "agg": "count",
+                       "k": 0})
+    doc = eng2.execute(plan, use_cache=False)
+    assert doc.get("rollup")
+    assert doc["rows"] == eng2.execute(
+        plan, use_cache=False, use_rollup=False)["rows"]
+
+
+def test_torn_config_keeps_previous_set(monkeypatch, tmp_path):
+    cfg = tmp_path / "views.json"   # the file _mk_db declared
+    db = _mk_db(monkeypatch, tmp_path, [VIEW_PLAIN])
+    assert set(db.rollups.views) == {"per_pair"}
+    db.insert_flows(_flows_batch(4, T0, T0 + 600))
+    # torn write: malformed JSON with a NEWER mtime
+    time.sleep(0.02)
+    cfg.write_text('{"views": [{"name": "broken"')
+    os.utime(cfg, (time.time() + 5, time.time() + 5))
+    db.rollups.maintain(now=T0 + 700)
+    assert set(db.rollups.views) == {"per_pair"}   # previous set
+    assert db.rollups.load_error
+    doc = ru.views_doc(db)
+    assert doc["loadError"]
+    # still maintaining: inserts keep folding through the old set
+    before = db.rollups.rows_applied
+    db.insert_flows(_flows_batch(5, T0 + 600, T0 + 1200))
+    assert db.rollups.rows_applied > before
+    # a repaired file recovers on the next maintenance pass
+    time.sleep(0.02)
+    cfg.write_text(json.dumps({"views": [VIEW_PLAIN, VIEW_FILTERED]}))
+    os.utime(cfg, (time.time() + 10, time.time() + 10))
+    db.rollups.maintain(now=T0 + 1400)
+    assert db.rollups.load_error is None
+    assert set(db.rollups.views) == {"per_pair", "allowed_only"}
+
+
+def test_replicated_frames_converge_follower_rollups(monkeypatch,
+                                                     tmp_path):
+    """Log-shipping replication: the follower applies the leader's
+    flows frames verbatim and re-derives the same rollup state — a
+    rollup-served query answers identically on both sides."""
+    db = _mk_db(monkeypatch, tmp_path, [VIEW_PLAIN])
+    db.attach_wal(str(tmp_path / "wl"), sync="always")
+    follower = FlowDatabase()
+    follower.attach_wal(str(tmp_path / "wf"), sync="always")
+    for i in range(3):
+        db.insert_flows(_flows_batch(i, T0 + i * 3600,
+                                     T0 + (i + 1) * 3600))
+    frames, last, algo = db.wal_read_frames(0, max_bytes=64 << 20)
+    out = follower.apply_replicated_frames(frames, algo)
+    assert out["ackedLsn"] == last
+    plan = parse_plan({"groupBy": "sourceIP",
+                       "agg": ["count", "sum:octetDeltaCount",
+                               "mean:throughput"],
+                       "start": T0 + 100, "end": T0 + 3 * 3600 - 100,
+                       "timeColumn": "timeInserted",
+                       "endColumn": "timeInserted", "k": 0})
+    d1 = QueryEngine(db).execute(plan, use_cache=False)
+    d2 = QueryEngine(follower).execute(plan, use_cache=False)
+    assert d1.get("rollup") and d2.get("rollup")
+    assert d1["rows"] == d2["rows"]
+    db.close_wal()
+    follower.close_wal()
+
+
+def test_ttl_eviction_tracks_rollups(monkeypatch, tmp_path):
+    db = _mk_db(monkeypatch, tmp_path, [VIEW_PLAIN])
+    db.ttl_seconds = 3600
+    now = T0 + 2 * 3600
+    db.insert_flows(_flows_batch(0, T0, T0 + 3600), now=T0 + 3600)
+    db.insert_flows(_flows_batch(1, T0 + 3600, now), now=now)
+    # TTL evicted rows below now - 3600; rollups must agree with raw
+    eng = QueryEngine(db)
+    plan = parse_plan({"groupBy": "destinationIP",
+                       "agg": ["count", "sum:octetDeltaCount"],
+                       "k": 0})
+    _assert_parity(eng, plan, oracle_db=db)
+
+
+# -- topologies ------------------------------------------------------------
+
+def test_sharded_store_rollup_parity(monkeypatch, tmp_path):
+    monkeypatch.setenv("THEIA_ROLLUP_VIEWS", _write_views(
+        tmp_path / "v.json", [VIEW_PLAIN]))
+    monkeypatch.setenv("THEIA_ROLLUP_DEFAULTS", "0")
+    db = ShardedFlowDatabase(n_shards=3)
+    assert all(s.rollups.active for s in db.shards)
+    for i in range(3):
+        db.insert_flows(_flows_batch(i, T0 + i * 3600,
+                                     T0 + (i + 1) * 3600))
+    eng = QueryEngine(db)
+    plan = parse_plan({"groupBy": "sourceIP,destinationIP",
+                       "agg": ["count", "sum:octetDeltaCount",
+                               "mean:throughput"],
+                       "start": T0 + 77, "end": T0 + 3 * 3600 - 13,
+                       "timeColumn": "timeInserted",
+                       "endColumn": "timeInserted", "k": 0})
+    doc_r = eng.execute(plan, use_cache=False)
+    doc_raw = eng.execute(plan, use_cache=False, use_rollup=False)
+    assert doc_r.get("rollup")
+    assert doc_r["rows"] == doc_raw["rows"]
+
+
+def test_three_node_scatter_gather_parity(monkeypatch, tmp_path):
+    """The acceptance bar's cluster half: a 3-node routing mesh
+    answers a rollup-subsumed plan identically with the rewrite on
+    and forced off, each peer serving O(groups) partials."""
+    from tests.test_distquery import (make_mesh, post_query,
+                                      shutdown_all, wait_heartbeats)
+    monkeypatch.setenv("THEIA_RETENTION_INTERVAL", "0")
+    monkeypatch.setenv("THEIA_CLUSTER_HEARTBEAT", "0.05")
+    monkeypatch.setenv("THEIA_CLUSTER_BOUNDS_INTERVAL", "0.02")
+    monkeypatch.setenv("THEIA_METRICS_SCRAPE_INTERVAL", "0")
+    monkeypatch.setenv("THEIA_ROLLUP_VIEWS", _write_views(
+        tmp_path / "v.json", [VIEW_PLAIN]))
+    ports, dbs, servers = make_mesh(3)
+    try:
+        for i, db in enumerate(dbs):
+            db.insert_flows(_flows_batch(i, T0 + i * 1800,
+                                         T0 + (i + 1) * 1800))
+        wait_heartbeats(servers)
+        qdoc = {"groupBy": "sourceIP",
+                "aggregates": ["count", "sum:octetDeltaCount",
+                               "mean:throughput"],
+                "start": T0 + 31, "end": T0 + 3 * 1800 - 17,
+                "timeColumn": "timeInserted",
+                "endColumn": "timeInserted", "k": 0, "cache": "0"}
+        before = ru._M_REWRITES._default.value()
+        served = post_query(ports[0], qdoc)
+        after = ru._M_REWRITES._default.value()
+        raw = post_query(ports[0], {**qdoc, "rollup": "0"})
+        assert served["partial"] is False
+        assert raw["partial"] is False
+        assert served["rows"] == raw["rows"]
+        # every node's partial (coordinator-local + 2 peers, all
+        # in-process) took the rewrite; the rollup=0 run took none
+        assert after - before >= 3
+        assert ru._M_REWRITES._default.value() == after
+    finally:
+        shutdown_all(servers)
+
+
+# -- operator surface ------------------------------------------------------
+
+def test_debug_views_endpoint_token_gated(monkeypatch, tmp_path):
+    from theia_tpu.manager.api import TheiaManagerServer
+    monkeypatch.setenv("THEIA_ROLLUP_VIEWS", _write_views(
+        tmp_path / "v.json", [VIEW_PLAIN]))
+    monkeypatch.setenv("THEIA_METRICS_SCRAPE_INTERVAL", "0")
+    monkeypatch.setenv("THEIA_RETENTION_INTERVAL", "0")
+    db = FlowDatabase()
+    db.insert_flows(_flows_batch(0, T0, T0 + 600))
+    srv = TheiaManagerServer(db, port=0, auth_token="sekrit")
+    srv.start_background()
+    try:
+        url = f"http://127.0.0.1:{srv.port}/debug/views"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(url, timeout=10)
+        assert ei.value.code == 401
+        req = urllib.request.Request(
+            url, headers={"Authorization": "Bearer sekrit"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            doc = json.load(r)
+        assert doc["enabled"] is True
+        names = [v["name"] for v in doc["views"]]
+        assert names == ["per_pair"]
+        v = doc["views"][0]
+        assert v["rows"] > 0
+        assert v["definition"]["bucketSeconds"] == 60
+        # maintenance loop runs even on the flat engine when rollup
+        # views are declared (tier folds need the cadence)
+        assert srv.maintenance is not None
+    finally:
+        srv.shutdown()
+
+
+def test_views_cli_renders(monkeypatch, tmp_path, capsys):
+    from theia_tpu.cli import __main__ as cli
+    from theia_tpu.manager.api import TheiaManagerServer
+    monkeypatch.setenv("THEIA_ROLLUP_VIEWS", _write_views(
+        tmp_path / "v.json", [VIEW_PLAIN]))
+    monkeypatch.setenv("THEIA_METRICS_SCRAPE_INTERVAL", "0")
+    monkeypatch.setenv("THEIA_RETENTION_INTERVAL", "0")
+    db = FlowDatabase()
+    db.insert_flows(_flows_batch(0, T0, T0 + 600))
+    srv = TheiaManagerServer(db, port=0)
+    srv.start_background()
+    try:
+        cli.main(["--manager-addr", f"http://127.0.0.1:{srv.port}",
+                  "views"])
+        out = capsys.readouterr().out
+        assert "per_pair" in out
+        assert "rows applied" in out
+    finally:
+        srv.shutdown()
+
+
+def test_hot_reload_rebuild_during_concurrent_ingest(monkeypatch,
+                                                     tmp_path):
+    """Regression: a config reload that rebuilds a redefined view
+    takes the ingest latch FIRST and the manager lock second — the
+    same order as the insert path — so a reload racing in-flight
+    ingest completes instead of deadlocking (latch-inside-lock hung
+    both threads forever), and the rebuilt view still answers
+    bit-identically to the raw scan."""
+    import threading
+    cfg = tmp_path / "views.json"
+    db = _mk_db(monkeypatch, tmp_path, [VIEW_PLAIN])
+    stop = threading.Event()
+    inserted = [0]
+
+    def ingest():
+        i = 100
+        while not stop.is_set():
+            db.insert_flows(_flows_batch(i, T0 + i * 60,
+                                         T0 + (i + 1) * 60,
+                                         n_series=8))
+            inserted[0] += 1
+            i += 1
+
+    t = threading.Thread(target=ingest, daemon=True)
+    t.start()
+    try:
+        for round_ in range(3):
+            changed = dict(VIEW_PLAIN)
+            changed["groupBy"] = (["sourceIP"] if round_ % 2
+                                  else ["sourceIP", "destinationIP"])
+            time.sleep(0.02)
+            cfg.write_text(json.dumps({"views": [changed]}))
+            os.utime(cfg, (time.time() + 10 + round_,) * 2)
+            done = threading.Event()
+            worker = threading.Thread(
+                target=lambda: (db.rollups.maintain(now=T0),
+                                done.set()),
+                daemon=True)
+            worker.start()
+            assert done.wait(timeout=30), \
+                "reload+rebuild deadlocked against concurrent ingest"
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    assert not t.is_alive() and inserted[0] > 0
+    eng = QueryEngine(db)
+    plan = parse_plan({"groupBy": "sourceIP", "agg": "count",
+                       "k": 0})
+    _assert_parity(eng, plan, expect_rollup=True, oracle_db=db)
+
+
+# -- shared fold helper (both callers regression) --------------------------
+
+def test_fold_rows_to_buckets_last_and_merge_semantics():
+    """The shared fold: last_columns keep the latest-time sample per
+    bucket, merge columns fold exactly, at-resolution rows pass
+    through — the exact `__metrics__` semantics, now also serving the
+    rollup tier cascade."""
+    from theia_tpu.schema import StringDictionary
+    d = StringDictionary()
+    codes = d.encode(["a", "a", "a", "b"])
+    batch = ColumnarBatch({
+        "timeInserted": np.array([0, 15, 30, 120], np.int64),
+        "metric": codes,
+        "resolution": np.array([15, 15, 15, 60], np.int64),
+        "value": np.array([1, 2, 3, 9], np.int64),
+        "valueSum": np.array([1, 2, 3, 9], np.int64),
+        "valueMin": np.array([1, 2, 3, 9], np.int64),
+    }, {"metric": d})
+    rows = ru.fold_rows_to_buckets(
+        batch, 60, ("metric",),
+        {"valueSum": "sum", "valueMin": "min"},
+        last_columns=("value",))
+    by_key = {(r["metric"], r["timeInserted"]): r for r in rows}
+    folded = by_key[("a", 0)]
+    assert folded["value"] == 3          # last sample in the bucket
+    assert folded["valueSum"] == 6
+    assert folded["valueMin"] == 1
+    assert folded["resolution"] == 60
+    passthrough = by_key[("b", 120)]
+    assert passthrough["value"] == 9     # already at resolution

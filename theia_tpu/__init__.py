@@ -31,4 +31,4 @@ Subpackages:
   utils     — shared helpers
 """
 
-__version__ = "0.6.0"
+__version__ = "0.7.0"

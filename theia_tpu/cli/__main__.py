@@ -1405,6 +1405,26 @@ def top(args) -> None:
                       f"cold {cold / 1e6:,.1f} MB, "
                       f"{dm / dt_p if dt_p > 0 else 0.0:,.2f} "
                       f"merges/s")
+            rv = sample.get(("theia_rollup_views", ()))
+            if rv:
+                # rollup-maintenance header: active views, fold rate
+                # of the insert path, cumulative tier folds — visible
+                # whenever rollup maintenance is active
+                dt_r = now - prev_t if prev is not None else 0.0
+                dr = 0.0
+                if prev is not None:
+                    dr = max(sample.get(
+                        ("theia_rollup_applied_rows_total", ()), 0.0)
+                        - prev.get(
+                            ("theia_rollup_applied_rows_total", ()),
+                            0.0), 0.0)
+                tier_folds = sum(
+                    value for (name, _labels), value in sample.items()
+                    if name == "theia_rollup_folds_total")
+                print(f"rollup views: {rv:,.0f} active, "
+                      f"{dr / dt_r if dt_r > 0 else 0.0:,.0f} "
+                      f"rows/s applied, "
+                      f"{tier_folds:,.0f} tier folds")
             qc = sample.get(("theia_query_seconds_count", ()))
             if qc is not None:
                 # query-engine header: query rate, scan rate, cache
@@ -1536,6 +1556,57 @@ def parts_cmd(args) -> None:
         } for e in entries]
         _print_table(rows, ["UID", "TIER", "FMT", "ROWS", "RAM",
                             "FILE", "GRANULES", "INDEX", "TIME-RANGE"])
+
+
+def views_cmd(args) -> None:
+    """`theia views` — the declared rollup views at inspection depth
+    (token-gated GET /debug/views): definitions, tiers, per-store
+    aggregate part/row counts, maintenance stats, loadError."""
+    doc = _request(args.manager_addr, "GET", "/debug/views")
+    if args.json:
+        print(json.dumps(doc, indent=2))
+        return
+    if not doc.get("enabled") or not doc.get("views"):
+        print("no rollup views declared (set THEIA_ROLLUP_VIEWS "
+              "and/or THEIA_ROLLUP_DEFAULTS=1)")
+        if doc.get("loadError"):
+            print(f"load error: {doc['loadError']}")
+        return
+    print(f"rollup views: {len(doc['views'])} declared across "
+          f"{doc.get('stores', 1)} store(s)  — "
+          f"{doc.get('rowsApplied', 0):,} rows applied, "
+          f"{doc.get('aggregateRows', 0):,} aggregate rows, "
+          f"{doc.get('folds', 0):,} tier folds, "
+          f"{doc.get('rebuilds', 0):,} rebuilds")
+    if doc.get("configPath"):
+        print(f"config: {doc['configPath']}")
+    if doc.get("loadError"):
+        print(f"LOAD ERROR (previous set still active): "
+              f"{doc['loadError']}")
+    rows = []
+    for v in doc["views"]:
+        d = v.get("definition") or {}
+        tiers = d.get("tiers") or []
+        tier_s = "→".join(
+            [f"{d.get('bucketSeconds', '?')}s"]
+            + [f"{t['resolutionSeconds']}s" for t in tiers])
+        aggs = d.get("aggregates") or []
+        agg_s = ",".join(
+            (a["op"] if not a.get("column")
+             else f"{a['op']}({a['column']})") for a in aggs)
+        rows.append({
+            "VIEW": v.get("name", ""),
+            "GROUP-BY": len(d.get("groupBy") or ()),
+            "AGGREGATES": agg_s[:40],
+            "TIERS": tier_s,
+            "FILTERS": len(d.get("filters") or ()),
+            "ROWS": f"{v.get('rows', 0):,}",
+            "PARTS": v.get("parts", 0),
+            "RES-SEEN": ",".join(
+                str(r) for r in (v.get("partResolutions") or ())),
+        })
+    _print_table(rows, ["VIEW", "GROUP-BY", "AGGREGATES", "TIERS",
+                        "FILTERS", "ROWS", "PARTS", "RES-SEEN"])
 
 
 def version(args) -> None:
@@ -1872,6 +1943,15 @@ def build_parser() -> argparse.ArgumentParser:
     pa.add_argument("--json", action="store_true",
                     help="print the raw /debug/parts document")
     pa.set_defaults(fn=parts_cmd)
+
+    vw = sub.add_parser("views",
+                        help="declared rollup views from the "
+                             "manager's GET /debug/views: "
+                             "definitions, tiers, aggregate part/row "
+                             "counts, maintenance stats, loadError")
+    vw.add_argument("--json", action="store_true",
+                    help="print the raw /debug/views document")
+    vw.set_defaults(fn=views_cmd)
 
     ver = sub.add_parser("version")
     ver.set_defaults(fn=version)

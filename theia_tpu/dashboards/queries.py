@@ -16,6 +16,7 @@ the HTML renderer (web.py) and the /dashboards/api endpoints.
 
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -24,6 +25,27 @@ from ..store import FlowDatabase
 from ..store.views import group_reduce
 
 FLOW_TYPE_TO_EXTERNAL = 3
+
+
+def _view_scan(db, name: str):
+    """One materialized view in the ViewTable.scan() shape, routed by
+    THEIA_DASHBOARD_ROLLUP: unset/0 reads the legacy in-memory view
+    table; `1` reads the rollup-backed `__rollup__:<view>` aggregate
+    parts (query/rollup.py — the view must be declared, e.g. via
+    THEIA_ROLLUP_DEFAULTS=1, else legacy serves); `assert` reads the
+    rollup path AND verifies it group-for-group against the legacy
+    table (the migration parity gate — raises on divergence)."""
+    mode = os.environ.get("THEIA_DASHBOARD_ROLLUP",
+                          "").strip().lower()
+    if mode in ("", "0", "off", "false", "no"):
+        return db.views[name].scan()
+    from ..query import rollup as _rollup
+    batch = _rollup.view_scan_batch(db, name)
+    if batch is None:
+        return db.views[name].scan()
+    if mode == "assert":
+        _rollup.assert_view_parity(batch, db.views[name].scan(), name)
+    return batch
 
 # NetworkPolicy rule-action codes (reference schema: 0 none, 1 allow,
 # 2 drop, 3 reject) — single source for every dashboard consumer.
@@ -153,7 +175,7 @@ def flow_records(db: FlowDatabase, limit: int = 100,
 
 def _pair_view(db: FlowDatabase, a_col: str, b_col: str,
                row_filter, k: int, start, end) -> Dict[str, object]:
-    view = db.views["flows_pod_view"].scan()
+    view = _view_scan(db, "flows_pod_view")
     mask = _time_window(np.asarray(view["flowEndSeconds"]), start, end)
     mask &= row_filter(view)
     a = np.asarray(view[a_col], np.int64)[mask]
@@ -199,7 +221,7 @@ def pod_to_external(db: FlowDatabase, k: int = 10, start=None,
 
 
 def node_to_node(db: FlowDatabase, k: int = 10, start=None, end=None):
-    view = db.views["flows_node_view"].scan()
+    view = _view_scan(db, "flows_node_view")
     mask = _time_window(np.asarray(view["flowEndSeconds"]), start, end)
     mask &= (np.asarray(view["sourceNodeName"]) != 0) \
         & (np.asarray(view["destinationNodeName"]) != 0)
@@ -218,7 +240,7 @@ def node_to_node(db: FlowDatabase, k: int = 10, start=None, end=None):
 def networkpolicy(db: FlowDatabase, k: int = 10, start=None, end=None):
     """Policy traffic chord (reference networkpolicy_dashboard.json):
     bytes per (egress policy, ingress policy) pair + allow/deny split."""
-    view = db.views["flows_policy_view"].scan()
+    view = _view_scan(db, "flows_policy_view")
     mask = _time_window(np.asarray(view["flowEndSeconds"]), start, end)
     eg = np.asarray(view["egressNetworkPolicyName"], np.int64)[mask]
     ing = np.asarray(view["ingressNetworkPolicyName"], np.int64)[mask]

@@ -561,6 +561,16 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
             limit = int(self._query().get("limit", "256"))
             self._send_json(self._parts_debug_doc(limit))
             return
+        if parts == ("debug", "views"):
+            # Declared rollup views at inspection depth (`theia
+            # views`): definitions, tiers, per-store part/row counts,
+            # maintenance stats, loadError — the /debug/parts shape
+            # and sensitivity class (view definitions narrate traffic
+            # shape), so token-gated.
+            self._require_auth()
+            from ..query.rollup import views_doc
+            self._send_json(views_doc(self.controller.db))
+            return
         if parts == ("query",):
             # Aggregation results decode flow identities (IPs, pods) —
             # the /alerts sensitivity class, so the token (when
@@ -572,7 +582,8 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
             self._serve_query(
                 self._plan_from_get(),
                 use_cache=self._cache_flag(q.get("cache", "1")),
-                explain=self._explain_flag(q.get("explain")))
+                explain=self._explain_flag(q.get("explain")),
+                use_rollup=self._cache_flag(q.get("rollup", "1")))
             return
         if parts == ("cluster", "ping"):
             # peer liveness + log-matching handshake; open (the
@@ -958,7 +969,8 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
         return str(raw).strip().lower() in ("1", "true", "yes")
 
     def _serve_query(self, plan, use_cache: bool = True,
-                     explain: bool = False) -> None:
+                     explain: bool = False,
+                     use_rollup: bool = True) -> None:
         """Shared GET/POST /query tail: admission, execution, timing
         headers. 400s (PlanError is a ValueError) and 429s surface
         through the verb handlers' taxonomy. On a routing-mesh node
@@ -980,7 +992,8 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
         engine = dist if dist is not None else self.queries
         self._send_json(engine.execute(
             plan, use_cache=use_cache, explain=explain,
-            traceparent=self.headers.get("traceparent")))
+            traceparent=self.headers.get("traceparent"),
+            use_rollup=use_rollup))
 
     def _send_ingest_redirect(self) -> None:
         """307 + Location at the current leader: this node is a
@@ -1012,7 +1025,8 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
             self._serve_query(
                 parse_plan(body),
                 use_cache=self._cache_flag(body.get("cache", "1")),
-                explain=self._explain_flag(body.get("explain")))
+                explain=self._explain_flag(body.get("explain")),
+                use_rollup=self._cache_flag(body.get("rollup", "1")))
             return
         if parts == ("query", "partial"):
             self._post_query_partial()
@@ -1095,7 +1109,9 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
                 "query.partial",
                 traceparent=self.headers.get("traceparent"),
                 coordinator=self.headers.get(NODE_HEADER) or ""):
-            raw = serve_partial(self.queries, plan, node_id=node_id)
+            raw = serve_partial(
+                self.queries, plan, node_id=node_id,
+                use_rollup=self._cache_flag(body.get("rollup", "1")))
         self.send_response(200)
         self.send_header("Content-Type", "application/octet-stream")
         self.send_header("Content-Length", str(len(raw)))
@@ -1303,7 +1319,14 @@ class TheiaManagerServer:
                 engine = store_stats().get("engine")
             except Exception:
                 engine = None
-            if engine == "parts":
+            from ..query.rollup import rollup_configured
+            if engine == "parts" or rollup_configured(db):
+                # rollup views need the maintenance cadence (config
+                # hot reload + tier folds + rollup-part compaction)
+                # even on a flat flows engine — their tables are
+                # parts-backed regardless, and a config source whose
+                # file is torn/missing AT BOOT still needs the
+                # cadence that will pick up its repair
                 from ..store import PartMaintenanceLoop
                 self.maintenance = PartMaintenanceLoop(
                     db, interval=merge_interval)

@@ -54,8 +54,6 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
-import numpy as np
-
 from ..schema import (METRICS_SCHEMA, METRICS_TABLE,
                       METRICS_VALUE_SCALE, ColumnarBatch)
 from ..utils.backoff import capped_backoff
@@ -207,95 +205,37 @@ def concrete_metrics_tables(db) -> List[object]:
 
 # -- downsampling (part surgery) -------------------------------------------
 
-def _fold_rows(batch: ColumnarBatch, resolution: int
-               ) -> List[Dict[str, object]]:
-    """Fold decoded rows into `resolution`-second buckets. Rows
-    already at or above the target resolution pass through unchanged
-    (recovery can reseal mixed-resolution parts); finer rows fold per
-    (metric, labels, node, kind, bucket): value = last sample in the
-    bucket, min/max/sum/count merge exactly."""
-    out: List[Dict[str, object]] = []
-    acc: Dict[tuple, Dict[str, object]] = {}
-    t = np.asarray(batch["timeInserted"], np.int64)
-    res = np.asarray(batch["resolution"], np.int64)
-    metric = batch.strings("metric")
-    labels = batch.strings("labels")
-    node = batch.strings("node")
-    kind = batch.strings("kind")
-    cols = {c: np.asarray(batch[c], np.int64)
-            for c in ("value", "valueMin", "valueMax", "valueSum",
-                      "valueCount")}
-    for i in range(len(batch)):
-        if res[i] >= resolution:
-            out.append({
-                "timeInserted": int(t[i]), "metric": str(metric[i]),
-                "labels": str(labels[i]), "node": str(node[i]),
-                "kind": str(kind[i]), "resolution": int(res[i]),
-                **{c: int(cols[c][i]) for c in cols}})
-            continue
-        bucket = int(t[i]) // resolution * resolution
-        key = (str(metric[i]), str(labels[i]), str(node[i]),
-               str(kind[i]), bucket)
-        row = acc.get(key)
-        if row is None:
-            acc[key] = {
-                "timeInserted": bucket, "metric": key[0],
-                "labels": key[1], "node": key[2], "kind": key[3],
-                "resolution": resolution,
-                "value": int(cols["value"][i]),
-                "valueMin": int(cols["valueMin"][i]),
-                "valueMax": int(cols["valueMax"][i]),
-                "valueSum": int(cols["valueSum"][i]),
-                "valueCount": int(cols["valueCount"][i]),
-                "_last_t": int(t[i])}
-            continue
-        if int(t[i]) >= row["_last_t"]:
-            row["_last_t"] = int(t[i])
-            row["value"] = int(cols["value"][i])
-        row["valueMin"] = min(row["valueMin"],
-                              int(cols["valueMin"][i]))
-        row["valueMax"] = max(row["valueMax"],
-                              int(cols["valueMax"][i]))
-        row["valueSum"] += int(cols["valueSum"][i])
-        row["valueCount"] += int(cols["valueCount"][i])
-    for row in acc.values():
-        row.pop("_last_t")
-        out.append(row)
-    return out
+#: the `__metrics__` fold shape: series identity keys, the exactly-
+#: mergeable aggregate columns, and the latest-sample `value` (exact
+#: bucket-end totals for cumulative counters)
+_FOLD_KEYS = ("metric", "labels", "node", "kind")
+_FOLD_MERGE = {"valueMin": "min", "valueMax": "max",
+               "valueSum": "sum", "valueCount": "sum"}
 
 
 def downsample_table(table, now: int,
                      tiers: Sequence[Tuple[int, int]]) -> int:
-    """One cascade pass over one concrete PartTable: for each
-    (resolution, age) tier, decode the sealed parts whose rows are all
-    older than `now - age` and not yet at that resolution, fold, and
-    atomically swap the old parts for one rollup part via the
-    PartTable's public surgery contract (`sealed_parts` +
-    `replace_parts` — the swap invariants live in store/parts.py with
-    the other part-mutation paths). Returns parts replaced; a swap
-    that loses to a concurrent merge/demote aborts for this tier and
-    the next pass retries against fresh state."""
-    if not callable(getattr(table, "sealed_parts", None)):
-        return 0   # flat Table (no parts engine) — nothing to do
-    replaced = 0
-    for resolution, age in tiers:
-        cutoff = int(now) - int(age)
-        eligible = [
-            p for p in table.sealed_parts()
-            if p.minmax.get("timeInserted") is not None
-            and p.minmax["timeInserted"][1] < cutoff
-            and p.minmax.get("resolution") is not None
-            and p.minmax["resolution"][0] < resolution]
-        if not eligible:
-            continue
-        batch = ColumnarBatch.concat(
-            [table._decode_part(p) for p in eligible])
-        folded = _fold_rows(batch, resolution)
-        if not table.replace_parts(eligible, folded):
-            continue
-        replaced += len(eligible)
+    """One cascade pass over one concrete PartTable, through the
+    SHARED part-surgery loop (query/rollup.py downsample_parts — the
+    same sealed-part selection + atomic replace_parts swap the
+    rollup-view tiers use). Returns parts replaced; a swap that loses
+    to a concurrent merge/demote aborts for this tier and the next
+    pass retries against fresh state."""
+    from ..query.rollup import downsample_parts, fold_rows_to_buckets
+
+    def fold(batch: ColumnarBatch, resolution: int):
+        return fold_rows_to_buckets(
+            batch, resolution, _FOLD_KEYS, _FOLD_MERGE,
+            time_column="timeInserted",
+            resolution_column="resolution",
+            last_columns=("value",))
+
+    per = downsample_parts(table, now, tiers, fold,
+                           time_column="timeInserted",
+                           resolution_column="resolution")
+    for resolution, replaced in per.items():
         _M_ROLLUPS.labels(resolution=str(resolution)).inc()
-    return replaced
+    return sum(per.values())
 
 
 class MetricsHistoryLoop:

@@ -284,7 +284,8 @@ class ClusterQueryCoordinator:
     def execute(self, plan: QueryPlan,
                 use_cache: bool = True,
                 explain: bool = False,
-                traceparent: Optional[str] = None
+                traceparent: Optional[str] = None,
+                use_rollup: bool = True
                 ) -> Dict[str, object]:
         """Coordinate one cluster-wide query. This is a trace ingress:
         the fan-out's `/query/partial` requests carry the minted (or
@@ -294,13 +295,15 @@ class ClusterQueryCoordinator:
         top-K time) without changing the result rows."""
         with _trace.ingress_span("query.request", engine="cluster",
                                  traceparent=traceparent) as sp:
-            doc = self._execute_traced(plan, use_cache, explain)
+            doc = self._execute_traced(plan, use_cache, explain,
+                                       use_rollup)
             sp.attrs["groups"] = doc.get("groupCount")
             sp.attrs["cache"] = doc.get("cache")
             return doc
 
     def _execute_traced(self, plan: QueryPlan, use_cache: bool,
-                        explain: bool) -> Dict[str, object]:
+                        explain: bool,
+                        use_rollup: bool = True) -> Dict[str, object]:
         t0 = time.perf_counter()
         others = self.cmap.others()
         epoch = self.cmap.membership_epoch()
@@ -319,6 +322,7 @@ class ClusterQueryCoordinator:
         local_fp = self.engine.fingerprint(
             self.engine._tables(plan.table))
         key = (plan.normalized(), local_fp, epoch,
+               bool(use_rollup),
                tuple(sorted((p, _peer_table_fp(peer_store[p],
                                                plan.table))
                             for p in others)))
@@ -360,14 +364,15 @@ class ClusterQueryCoordinator:
         if live:
             pool = get_pool("query-fanout", self.workers)
             futs = [(p, pool.submit(self._fetch_partial, p, plan,
-                                    ctx))
+                                    ctx, use_rollup))
                     for p in live]
         # local partial executes on the coordinator thread while the
         # fan-out is in flight (sharing `prof`, so the local store's
         # per-part scanned/pruned detail lands in the profile)
         stats = {"rowsScanned": 0, "partsScanned": 0, "partsPruned": 0,
                  "granulesScanned": 0, "granulesSkipped": 0}
-        results = [self.engine.execute_partial(plan, stats, prof)]
+        results = [self.engine.execute_partial(plan, stats, prof,
+                                               use_rollup)]
         failed: List[str] = []
         peer_errors: Dict[str, str] = {}
         bytes_shipped = 0
@@ -490,7 +495,8 @@ class ClusterQueryCoordinator:
             doc["profile"] = profile
         return doc
 
-    def _fetch_partial(self, peer: str, plan: QueryPlan, ctx=None):
+    def _fetch_partial(self, peer: str, plan: QueryPlan, ctx=None,
+                       use_rollup: bool = True):
         """One peer's partial over the cluster transport (persistent
         connection; `net.send`/`peer.partition` fault sites fire
         inside, so partition drills sever the read path too). Runs on
@@ -500,7 +506,8 @@ class ClusterQueryCoordinator:
         with _trace.child_span("query.fanout", ctx, peer=peer):
             raw = self.transport.request_raw(
                 peer, "/query/partial",
-                data=json.dumps({"plan": plan.to_doc()}).encode(),
+                data=json.dumps({"plan": plan.to_doc(),
+                                 "rollup": bool(use_rollup)}).encode(),
                 headers={"Content-Type": "application/json"},
                 timeout=self.timeout)
         meta, batch = unpack_partial(raw)
@@ -528,7 +535,8 @@ class ClusterQueryCoordinator:
 
 
 def serve_partial(engine, plan: QueryPlan,
-                  node_id: str = "") -> bytes:
+                  node_id: str = "",
+                  use_rollup: bool = True) -> bytes:
     """Server half of the fan-out (manager/api.py `/query/partial`):
     execute the local partial and pack the TQPF frame. The meta
     carries this node's scan stats (the coordinator sums them into
@@ -536,7 +544,8 @@ def serve_partial(engine, plan: QueryPlan,
     t0 = time.perf_counter()
     stats = {"rowsScanned": 0, "partsScanned": 0, "partsPruned": 0,
              "granulesScanned": 0, "granulesSkipped": 0}
-    keys, aggs = engine.execute_partial(plan, stats)
+    keys, aggs = engine.execute_partial(plan, stats,
+                                        use_rollup=use_rollup)
     _M_PARTIALS_SERVED.inc()
     meta: Dict[str, object] = {"node": node_id, **stats,
                                "fingerprint": engine.fingerprint_hash(

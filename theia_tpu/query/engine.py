@@ -471,17 +471,23 @@ class QueryEngine:
     def execute(self, plan: QueryPlan,
                 use_cache: bool = True,
                 explain: bool = False,
-                traceparent: Optional[str] = None
+                traceparent: Optional[str] = None,
+                use_rollup: bool = True
                 ) -> Dict[str, object]:
         """Run one plan; returns the result doc. Raises PlanError
         (from parsing, upstream), QueryError, or the store's
         availability errors. `explain=True` attaches the execution
         profile (query/explain.py) WITHOUT re-running anything — the
         result rows are bit-identical either way; `traceparent`
-        adopts a caller's trace context (this is a trace ingress)."""
+        adopts a caller's trace context (this is a trace ingress);
+        `use_rollup=False` (the request's `rollup=0` flag) forces the
+        raw-scan path even when a declared rollup view subsumes the
+        plan — the bench's A/B lever and the parity tests' oracle
+        side."""
         with _trace.ingress_span("query.request",
                                  traceparent=traceparent) as sp:
-            doc = self._execute_traced(plan, use_cache, explain)
+            doc = self._execute_traced(plan, use_cache, explain,
+                                       use_rollup)
             sp.attrs["groups"] = doc.get("groupCount")
             sp.attrs["cache"] = doc.get("cache")
             return doc
@@ -495,7 +501,8 @@ class QueryEngine:
             doc["traceId"] = ctx.trace_id
 
     def _execute_traced(self, plan: QueryPlan, use_cache: bool,
-                        explain: bool) -> Dict[str, object]:
+                        explain: bool,
+                        use_rollup: bool = True) -> Dict[str, object]:
         with self._lock:
             self.queries += 1
         t0 = time.perf_counter()
@@ -508,7 +515,10 @@ class QueryEngine:
         # entirely
         caching = use_cache and self.cache.max_bytes > 0
         if caching:
-            key = (plan.normalized(), fp)
+            # the rollup flag joins the key: the ROWS are identical
+            # either way (the parity gate), but the doc's rollup/scan
+            # accounting differs and must not leak across flags
+            key = (plan.normalized(), fp, bool(use_rollup))
             hit = self.cache.lookup(key)
             if hit is not None:
                 _M_CACHE_HITS.inc()
@@ -534,8 +544,8 @@ class QueryEngine:
         stats = {"rowsScanned": 0, "partsScanned": 0, "partsPruned": 0,
                  "granulesScanned": 0, "granulesSkipped": 0}
         t_exec = time.perf_counter()
-        keys, aggs = self._partial_for_tables(plan, tables, stats,
-                                              prof)
+        keys, aggs, rollup_info = self._partial_with_rollup(
+            plan, tables, stats, prof, use_rollup)
         t_fin = time.perf_counter()
         if aggs is None or _n_groups(aggs) == 0:
             rows, groups = empty_result(plan)
@@ -563,6 +573,12 @@ class QueryEngine:
             "tookMs": round(took * 1000, 3),
             "cache": "miss" if caching else "off",
         }
+        if rollup_info is not None:
+            # the planner-rewrite story rides the result doc: which
+            # view answered, the alignment tier, and the stitched
+            # raw-scan edge spans — the rows are bit-identical to the
+            # raw path either way
+            doc["rollup"] = rollup_info
         if caching:
             # the cached doc carries no profile or trace id: a later
             # hit under the same key would serve a stale one
@@ -573,6 +589,9 @@ class QueryEngine:
         if prof is not None:
             prof.phase("execute", t_fin - t_exec)
             prof.phase("finalize", time.perf_counter() - t_fin)
+            extra: Dict[str, object] = {}
+            if rollup_info is not None:
+                extra["rollup"] = rollup_info
             profile = prof.doc(
                 engine=doc["engine"],
                 kernel=kernels.kernel_mode(),
@@ -583,6 +602,7 @@ class QueryEngine:
                 partsPruned=stats["partsPruned"],
                 granulesScanned=stats["granulesScanned"],
                 granulesSkipped=stats["granulesSkipped"],
+                **extra,
             )
             SLOW_QUERIES.observe(plan, doc, prof, profile)
         if explain and profile is not None:
@@ -601,7 +621,8 @@ class QueryEngine:
 
     def execute_partial(self, plan: QueryPlan,
                         stats: Optional[Dict[str, int]] = None,
-                        prof: Optional[QueryProfiler] = None
+                        prof: Optional[QueryProfiler] = None,
+                        use_rollup: bool = True
                         ) -> Tuple[Optional[List[np.ndarray]],
                                    Optional[Dict[str, np.ndarray]]]:
         """One node's share of a distributed query: (materialized
@@ -609,15 +630,41 @@ class QueryEngine:
         store only — the `/query/partial` server half. No finalize, no
         top-K, no cache: partials must merge exactly on the
         coordinator, and the top-K cut is only correct after that
-        merge (query/distributed.py)."""
+        merge (query/distributed.py). The rollup planner rewrite
+        applies HERE too, so a coordinator gets O(groups) partials
+        even when this peer's window is cold month-scale history."""
         if stats is None:
             stats = {"rowsScanned": 0, "partsScanned": 0,
                      "partsPruned": 0, "granulesScanned": 0,
                      "granulesSkipped": 0}
         for k in ("granulesScanned", "granulesSkipped"):
             stats.setdefault(k, 0)
-        return self._partial_for_tables(plan, self._tables(plan.table),
-                                        stats, prof)
+        keys, aggs, _ = self._partial_with_rollup(
+            plan, self._tables(plan.table), stats, prof, use_rollup)
+        return keys, aggs
+
+    def _partial_with_rollup(self, plan: QueryPlan, tables, stats,
+                             prof: Optional[QueryProfiler],
+                             use_rollup: bool
+                             ) -> Tuple[Optional[List[np.ndarray]],
+                                        Optional[Dict[str,
+                                                      np.ndarray]],
+                                        Optional[Dict[str, object]]]:
+        """(keys, aggs, rollup-info): the rollup planner rewrite when
+        a declared view subsumes the plan (query/rollup.py — aligned
+        middle from aggregate parts, raw-scan edges stitched), else
+        the normal raw path with info=None."""
+        if use_rollup:
+            from . import rollup as _rollup
+            view = _rollup.match_view(self.db, plan)
+            if view is not None:
+                res = _rollup.try_rollup_partial(self, plan, stats,
+                                                 prof, view)
+                if res is not None:
+                    return res
+        keys, aggs = self._partial_for_tables(plan, tables, stats,
+                                              prof)
+        return keys, aggs, None
 
     # -- per-table execution -----------------------------------------------
 
@@ -632,16 +679,21 @@ class QueryEngine:
         return merge_materialized(plan, table_results)
 
     def _execute_table(self, plan: QueryPlan, table, stats,
-                       prof: Optional[QueryProfiler] = None
+                       prof: Optional[QueryProfiler] = None,
+                       refs=None
                        ) -> Tuple[Optional[List[np.ndarray]],
                                   Optional[Dict[str, np.ndarray]]]:
         """One table → (materialized key columns, merged aggregates)
-        or (None, None) when nothing survives."""
+        or (None, None) when nothing survives. `refs` pins a caller's
+        pre-captured (parts, memtable) snapshot — the rollup rewrite
+        computes its window alignment from one capture and must
+        evaluate exactly that capture."""
         if getattr(table, "_parts", None) is None:
             partial, scanned = self._flat_partial(plan, table, prof)
             stats["rowsScanned"] += scanned
         else:
-            partial = self._parts_partials(plan, table, stats, prof)
+            partial = self._parts_partials(plan, table, stats, prof,
+                                           refs=refs)
         if partial is None:
             return None, None
         uniq, aggs = partial
@@ -743,8 +795,8 @@ class QueryEngine:
         return keep, reasons
 
     def _parts_partials(self, plan: QueryPlan, table, stats,
-                        prof: Optional[QueryProfiler] = None
-                        ) -> Partial:
+                        prof: Optional[QueryProfiler] = None,
+                        refs=None) -> Partial:
         """Parts engine: prune (whole parts from min/max + code sets,
         then GRANULES inside surviving sorted parts from their skip
         indexes) → stripe live parts across the worker pool (each
@@ -757,7 +809,7 @@ class QueryEngine:
         never work."""
         specs = lower_specs(plan)
         filters = [_CompiledFilter(f, table) for f in plan.filters]
-        parts, mem = table._snapshot_refs()
+        parts, mem = table._snapshot_refs() if refs is None else refs
         #: (part, surviving-row selection or None for all rows)
         live: List[Tuple[object, Optional[np.ndarray]]] = []
         pruned = 0

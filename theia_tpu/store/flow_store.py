@@ -815,6 +815,16 @@ class FlowDatabase:
         self.views: Dict[str, ViewTable] = {
             name: ViewTable(name, spec, self.flows.dicts)
             for name, spec in MATERIALIZED_VIEWS.items()}
+        # Streaming rollup views (query/rollup.py): declarative
+        # aggregate views maintained incrementally per insert block
+        # into parts-backed `__rollup__:<view>` tables. Deliberately
+        # OUTSIDE result_tables: rollup state is derived from the
+        # journaled flows rows (the WAL-invisible PR-13 contract), so
+        # it must not get a WAL hook — replaying flows records
+        # re-derives it through this same insert path. Lazy import:
+        # the query package is a read-plane consumer of this module.
+        from ..query.rollup import RollupManager
+        self.rollups = RollupManager(self)
         self.ttl_seconds = ttl_seconds
         #: attached WriteAheadLog (None = snapshot-only durability)
         self._wal = None
@@ -883,6 +893,11 @@ class FlowDatabase:
             for view in views:
                 view.apply_insert_block(adopted)
         _M_MV_FANOUT.observe(time.perf_counter() - t_mv)
+        rollups = getattr(self, "rollups", None)
+        if rollups is not None and rollups.active:
+            # rollup views fold the same adopted block (and recovery
+            # replays reach here too, re-deriving identical state)
+            rollups.apply_insert_block(adopted)
         _M_INS_ROWS.inc(len(adopted))
         _M_INS_BYTES.inc(sum(a.nbytes
                              for a in adopted.columns.values()))
@@ -932,9 +947,16 @@ class FlowDatabase:
 
     def maintenance_tick(self) -> int:
         """One background-compaction pass over the flows table (parts
-        engine; 0 merges on flat). Driven by PartMaintenanceLoop."""
+        engine; 0 merges on flat) plus rollup-view maintenance
+        (config hot reload, tier downsampling cascade, rollup-part
+        compaction — the rollup tables are parts-backed regardless of
+        the flows engine). Driven by PartMaintenanceLoop."""
         fn = getattr(self.flows, "maintain", None)
-        return int(fn()) if callable(fn) else 0
+        merges = int(fn()) if callable(fn) else 0
+        rollups = getattr(self, "rollups", None)
+        if rollups is not None and rollups.active:
+            merges += rollups.maintain()
+        return merges
 
     # -- write-ahead log ---------------------------------------------------
 
@@ -1229,6 +1251,10 @@ class FlowDatabase:
             self.flows.truncate()
             for view in self.views.values():
                 view.truncate()
+            if self.rollups is not None:
+                # re-derived below: every applied flows record runs
+                # the full insert path, rollup fold included
+                self.rollups.truncate_all()
             for t in self.result_tables.values():
                 t.truncate()
             for body in records:
@@ -1318,6 +1344,13 @@ class FlowDatabase:
         deleted = self.flows.delete_older_than(boundary)
         for view in self.views.values():
             view.delete_older_than(boundary)
+        rollups = getattr(self, "rollups", None)
+        if rollups is not None and rollups.active:
+            # whole buckets below the trim drop with their parts;
+            # boundary-straddling buckets re-derive from the
+            # SURVIVING raw rows so rollup answers track the trim
+            # exactly
+            rollups.apply_delete(boundary)
         return deleted
 
     def monitor(self, capacity_bytes: int, **kw) -> RetentionMonitor:
@@ -1393,6 +1426,14 @@ class FlowDatabase:
                 keys, values = view._merged()
                 payload[f"__view__/{name}/keys"] = keys
                 payload[f"__view__/{name}/values"] = values
+            rollups = getattr(self, "rollups", None)
+            if rollups is not None and rollups.active:
+                # rollup aggregates persist like the view aggregates
+                # (captured under the same latch, so the stamp
+                # partitions flows records exactly); flat snapshots
+                # skip this — their load rebuilds through the insert
+                # path
+                payload.update(rollups.snapshot_payload())
         gen = flows.publish_manifest(entries, stamp)
         payload["__parts__/generation"] = np.asarray(gen, np.int64)
         payload["__parts__/dir"] = np.asarray(
@@ -1559,5 +1600,10 @@ class FlowDatabase:
                 for view in db.views.values():
                     view.truncate()
                     view.apply_insert_block(data)
+            if db.rollups.active:
+                # rollup aggregates: restore views whose persisted
+                # definition still matches; rebuild the rest from the
+                # loaded flows (definition drift / older snapshot)
+                db.rollups.restore_or_rebuild(payload)
         db.ttl_seconds = ttl_seconds
         return db

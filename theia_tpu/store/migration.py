@@ -18,7 +18,9 @@ Schema history (mirrors the reference's column evolution):
   v6 — + `flowpatterns`, `spatialnoise`  (pattern mining + spatial
         DBSCAN result tables)
   v7 — + `__metrics__` result table      (self-scraped metrics
-        history; current)
+        history)
+  v8 — + `__rollup__/<view>/*` payloads  (streaming rollup-view
+        aggregate state stamped with its view definition; current)
 """
 
 from __future__ import annotations
@@ -28,7 +30,7 @@ from typing import Callable, Dict, List
 
 import numpy as np
 
-CURRENT_SCHEMA_VERSION = 7
+CURRENT_SCHEMA_VERSION = 8
 VERSION_KEY = "__schema_version__"
 
 # framework version → schema version (reference VERSION_MAP,
@@ -41,6 +43,7 @@ VERSION_MAP = {
     "0.4.0": 5,
     "0.5.0": 6,
     "0.6.0": 7,
+    "0.7.0": 8,
 }
 
 Payload = Dict[str, np.ndarray]
@@ -110,7 +113,21 @@ MIGRATIONS: List[Migration] = [
         version=7, name="add_metrics_history_table",
         up=lambda p: _add_empty_table(p, "__metrics__"),
         down=lambda p: _drop_table(p, "__metrics__")),
+    Migration(
+        version=8, name="add_rollup_view_payloads",
+        # Rollup aggregate state is OPTIONAL in a snapshot: a v8 load
+        # with no `__rollup__/...` keys simply rebuilds the declared
+        # views from the flows rows (query/rollup.py
+        # restore_or_rebuild), so upgrading is a no-op. Downgrading
+        # drops the payloads a pre-v8 reader would not understand.
+        up=lambda p: None,
+        down=lambda p: _drop_prefix(p, "__rollup__/")),
 ]
+
+
+def _drop_prefix(payload: Payload, prefix: str) -> None:
+    for key in [k for k in payload if k.startswith(prefix)]:
+        payload.pop(key)
 
 
 def _drop_key(payload: Payload, key: str) -> None:

@@ -321,6 +321,8 @@ class ReplicatedFlowDatabase:
             stale.flows.truncate()
             for view in stale.views.values():
                 view.truncate()
+            from ..query.rollup import truncate_rollups
+            truncate_rollups(stale)   # re-derived by insert_flows
             flows = peer.flows.scan()
             if len(flows):
                 stale.insert_flows(flows)
