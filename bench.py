@@ -960,6 +960,83 @@ def run_benchmarks() -> dict:
     except Exception as e:
         print(f"tracing-overhead bench skipped: {e}", file=sys.stderr)
 
+    # Lockdep-witness overhead: the SAME IngestManager A/B shape,
+    # flipping THEIA_LOCKDEP 0 <-> 1 around CONSTRUCTION (the witness
+    # decision is made at lock creation, so each pass builds a fresh
+    # engine; module-level locks keep whatever the process was born
+    # with — instance locks dominate the ingest path, and the leg
+    # honestly measures the armed-in-this-process cost an operator
+    # pays turning the witness on for a deadlock hunt). Budget: <=3%
+    # — the witness is a test-time gate, but it must stay cheap
+    # enough to arm in production. THEIA_BENCH_FAST runs one
+    # interleave instead of three.
+    lockdep_rate = 0.0
+    lockdep_overhead_pct = None
+    lockdep_times = {"off": [], "on": []}
+    try:
+        import contextlib
+
+        from theia_tpu.ingest import BlockEncoder, native_available
+        from theia_tpu.manager.ingest import IngestManager
+        from theia_tpu.store import FlowDatabase
+
+        if native_available():
+            def cpu_ctx_l():
+                try:
+                    return jax.default_device(jax.devices("cpu")[0])
+                except Exception:
+                    return contextlib.nullcontext()
+            bigl = generate_flows(SynthConfig(n_series=2000,
+                                              points_per_series=30))
+
+            def lockdep_pass():
+                iml = IngestManager(FlowDatabase(ttl_seconds=12 * 3600))
+                encl = BlockEncoder(dicts=bigl.dicts)
+                payloads = [encl.encode(bigl) for _ in range(9)]
+                iml.ingest(payloads[0])   # warm dicts + jit
+                tl = time.perf_counter()
+                n = sum(iml.ingest(p)["rows"] for p in payloads[1:])
+                dtl = time.perf_counter() - tl
+                iml.close()
+                return n / dtl, dtl
+
+            saved_ld = os.environ.get("THEIA_LOCKDEP")
+            lrates = {"off": 0.0, "on": 0.0}
+            iters = (1 if os.environ.get("THEIA_BENCH_FAST") == "1"
+                     else 3)
+            try:
+                with cpu_ctx_l():
+                    # interleaved best-of-N with ALTERNATING order:
+                    # a fixed off-then-on order folds first-pass
+                    # warm-up (allocator, caches) into the SAME side
+                    # every interleave and reads as a systematic
+                    # bias, not noise — alternation cancels it
+                    for i in range(iters):
+                        order = ("0", "1") if i % 2 == 0 else ("1",
+                                                               "0")
+                        for mode in order:
+                            os.environ["THEIA_LOCKDEP"] = mode
+                            r, dt = lockdep_pass()
+                            key = "on" if mode == "1" else "off"
+                            lrates[key] = max(lrates[key], r)
+                            lockdep_times[key].append(dt)
+            finally:
+                if saved_ld is None:
+                    os.environ.pop("THEIA_LOCKDEP", None)
+                else:
+                    os.environ["THEIA_LOCKDEP"] = saved_ld
+            lockdep_rate = lrates["on"]
+            if lrates["off"] > 0:
+                lockdep_overhead_pct = round(
+                    (lrates["off"] - lrates["on"])
+                    / lrates["off"] * 100, 2)
+            print(f"ingest with lockdep witness: "
+                  f"{lockdep_rate:,.0f} rows/s "
+                  f"(witness off: {lrates['off']:,.0f}; overhead "
+                  f"{lockdep_overhead_pct}%)", file=sys.stderr)
+    except Exception as e:
+        print(f"lockdep-overhead bench skipped: {e}", file=sys.stderr)
+
     # WAL durability tax: e2e ingest throughput (the acceptance
     # surface — decode ∥ store+WAL ∥ detector, where spare cores can
     # absorb the journaling) per sync policy vs the WAL-off baseline,
@@ -2453,6 +2530,13 @@ def run_benchmarks() -> dict:
         result["ingest_metrics_overhead_pct"] = metrics_overhead_pct
     if tracing_overhead_pct is not None:
         result["ingest_tracing_overhead_pct"] = tracing_overhead_pct
+    if lockdep_overhead_pct is not None:
+        result["ingest_lockdep_rows_per_sec"] = round(lockdep_rate)
+        result["lockdep_overhead_pct"] = lockdep_overhead_pct
+        leg_stats["ingest_lockdep_on"] = _leg_stats(
+            lockdep_times["on"])
+        leg_stats["ingest_lockdep_off"] = _leg_stats(
+            lockdep_times["off"])
     if wal_rates:
         result["wal_ingest_rows_per_sec"] = wal_rates
     if wal_store_rates:

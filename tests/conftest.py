@@ -8,6 +8,13 @@ Environment variables must be set before the first jax import.
 
 import os
 
+# Arm the runtime lockdep witness for the WHOLE suite (must happen
+# before theia_tpu imports — lock wrapping is decided at creation):
+# every test run doubles as a deadlock hunt. A session-scoped fixture
+# below asserts zero observed lock-order inversions at teardown.
+# THEIA_LOCKDEP=0 in the environment opts a run out (bench A/B).
+os.environ.setdefault("THEIA_LOCKDEP", "1")
+
 # Force CPU even if the ambient environment points JAX at an accelerator:
 # tests validate numerics in float64 (golden comparisons) and sharding on
 # 8 virtual devices, neither of which wants the single real chip.
@@ -49,6 +56,28 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if "device" in item.keywords:
             item.add_marker(skip)
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _lockdep_zero_inversions():
+    """The suite-wide deadlock hunt: every lock in the package runs
+    witnessed (THEIA_LOCKDEP=1 above), and ANY observed lock-order
+    inversion — even one that never deadlocked this run — fails the
+    session at teardown. Tests that build deliberate inversions use
+    lockdep.scoped() so fixtures don't trip this gate."""
+    from theia_tpu.analysis import lockdep
+    yield
+    if not lockdep.enabled():
+        return
+    inv = lockdep.inversions()
+    assert not inv, (
+        "lockdep witnessed lock-order inversion(s) during the run "
+        "(a deadlock waiting for the right interleaving):\n"
+        + "\n".join(
+            f"  cycle {' -> '.join(i['cycle'])} — new edge "
+            f"{i['edge'][0]} -> {i['edge'][1]} at {i['site']} "
+            f"(thread {i['thread']}); prior sites: {i['priorSites']}"
+            for i in inv))
 
 
 @pytest.fixture(scope="session")

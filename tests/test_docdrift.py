@@ -128,3 +128,34 @@ def test_metrics_env_knobs_in_sync():
     assert not stale, (
         f"docs/metrics.md documents observability env vars nothing "
         f"reads (renamed or removed?): {stale}")
+
+
+def test_all_theia_env_knobs_in_sync():
+    """EVERY ``THEIA_*`` environment knob, both directions, driven by
+    the analysis lint pass's AST extraction (docstrings and comments
+    don't count as reads; knob names passed as data do — they are
+    read through a variable later):
+
+    1. every knob the code reads has a ``| `THEIA_X` |`` knob-table
+       row in SOME docs/*.md — an operator can discover it;
+    2. every knob any docs table documents is actually read — the doc
+       cannot describe a removed or renamed knob.
+
+    The per-family gate above keeps metrics.md the single home for
+    the observability families; this one closes the other ~70 knobs
+    that previously had no gate at all."""
+    from theia_tpu.analysis.lint import (
+        documented_env_knobs,
+        extract_env_reads,
+    )
+    referenced = set(extract_env_reads(
+        str(PACKAGE_DIR), extra=[str(REPO / "bench.py")]))
+    documented = set(documented_env_knobs(str(REPO / "docs")))
+    undocumented = sorted(referenced - documented)
+    stale = sorted(documented - referenced)
+    assert not undocumented, (
+        f"THEIA_* env vars read by code (theia_tpu/ + bench.py) with "
+        f"no knob-table row in any docs/*.md: {undocumented}")
+    assert not stale, (
+        f"docs/*.md knob tables document THEIA_* vars nothing reads "
+        f"(renamed or removed?): {stale}")

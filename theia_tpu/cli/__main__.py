@@ -1472,6 +1472,28 @@ def top(args) -> None:
                     print(f"query fanout: {fanq:,.0f} peers queried, "
                           f"{fanp:,.0f} pruned, {fanf:,.0f} failed, "
                           f"{fb / 1e3:,.1f} KB partials shipped")
+            ld = sample.get(("theia_lockdep_locks", ()))
+            if ld:
+                # lockdep header: witness scope + the one number that
+                # must stay zero, plus the currently worst lock by
+                # cumulative wait (contention hot spot at a glance)
+                inv_n = sample.get(
+                    ("theia_lockdep_inversions", ()), 0.0)
+                edges_n = sample.get(("theia_lockdep_edges", ()), 0.0)
+                worst, worst_wait = "", 0.0
+                for (name, labels), value in sample.items():
+                    if name == "theia_lockdep_wait_seconds_total" \
+                            and labels and value > worst_wait:
+                        worst, worst_wait = labels[0][1], value
+                line = (f"lockdep: {ld:,.0f} locks, "
+                        f"{edges_n:,.0f} order edges, "
+                        f"{inv_n:,.0f} inversions")
+                if inv_n:
+                    line += "  ** LATENT DEADLOCK — see theia locks"
+                if worst:
+                    line += (f"; top wait: {worst} "
+                             f"({worst_wait:.2f}s total)")
+                print(line)
             qd = sample.get(("theia_fused_queue_depth", ()))
             if qd is not None:
                 # fused-engine header: pipeline backlog + step rate +
@@ -1607,6 +1629,61 @@ def views_cmd(args) -> None:
         })
     _print_table(rows, ["VIEW", "GROUP-BY", "AGGREGATES", "TIERS",
                         "FILTERS", "ROWS", "PARTS", "RES-SEEN"])
+
+
+def locks_cmd(args) -> None:
+    """`theia locks` — the runtime lockdep witness at inspection
+    depth (token-gated GET /debug/locks): per-lock acquire/contention
+    counts, wait and hold p95s, the observed acquisition-order edges,
+    and any witnessed inversions."""
+    doc = _request(args.manager_addr, "GET", "/debug/locks")
+    if args.json:
+        print(json.dumps(doc, indent=2))
+        return
+    if not doc.get("enabled"):
+        print("lockdep witness: off (start the manager with "
+              "THEIA_LOCKDEP=1 to arm it)")
+        return
+    stats = doc.get("stats") or {}
+    edges = doc.get("orderEdges") or []
+    inv = doc.get("inversions") or []
+    print(f"lockdep witness: {len(doc.get('locks') or ())} lock "
+          f"classes, {len(edges)} order edges, "
+          f"{len(inv)} inversion(s)")
+    if inv:
+        for i in inv:
+            print(f"  INVERSION: {' -> '.join(i.get('cycle', ()))} "
+                  f"(new edge at {i.get('site', '?')}, thread "
+                  f"{i.get('thread', '?')})")
+    rows = []
+    order = sorted(stats.items(),
+                   key=lambda kv: -kv[1].get("waitTotalSeconds", 0.0))
+    for name, s in order[:args.limit]:
+        rows.append({
+            "LOCK": name,
+            "ACQUIRES": f"{s.get('acquires', 0):,}",
+            "CONTENDED": f"{s.get('contended', 0):,}",
+            "WAIT-P95": f"{s.get('waitP95Seconds', 0.0) * 1e3:.3f}ms",
+            "WAIT-MAX": f"{s.get('waitMaxSeconds', 0.0) * 1e3:.2f}ms",
+            "HOLD-P95": f"{s.get('holdP95Seconds', 0.0) * 1e3:.3f}ms",
+            "HOLD-TOT": f"{s.get('holdTotalSeconds', 0.0):.2f}s",
+        })
+    if rows:
+        _print_table(rows, ["LOCK", "ACQUIRES", "CONTENDED",
+                            "WAIT-P95", "WAIT-MAX", "HOLD-P95",
+                            "HOLD-TOT"])
+    if args.edges and edges:
+        erows = [{"HELD": e.get("held", ""),
+                  "THEN-ACQUIRED": e.get("acquired", ""),
+                  "FIRST-SEEN": e.get("site", "")}
+                 for e in edges]
+        _print_table(erows, ["HELD", "THEN-ACQUIRED", "FIRST-SEEN"])
+    nesting = doc.get("selfNesting") or {}
+    if nesting:
+        print("same-class nesting (instance order unproven — see "
+              "docs/analysis.md): "
+              + ", ".join(f"{k} x{v}"
+                          for k, v in sorted(nesting.items())))
 
 
 def version(args) -> None:
@@ -1952,6 +2029,18 @@ def build_parser() -> argparse.ArgumentParser:
     vw.add_argument("--json", action="store_true",
                     help="print the raw /debug/views document")
     vw.set_defaults(fn=views_cmd)
+
+    lk = sub.add_parser(
+        "locks",
+        help="lockdep witness: per-lock contention/hold stats, "
+             "observed order edges, inversions (GET /debug/locks)")
+    lk.add_argument("--json", action="store_true",
+                    help="raw JSON document")
+    lk.add_argument("--edges", action="store_true",
+                    help="also print the observed order-edge table")
+    lk.add_argument("--limit", type=int, default=30,
+                    help="stats rows shown (sorted by total wait)")
+    lk.set_defaults(fn=locks_cmd)
 
     ver = sub.add_parser("version")
     ver.set_defaults(fn=version)

@@ -39,6 +39,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from ..obs import metrics as _metrics
 from ..utils.env import env_float
 from ..utils.logging import get_logger
+from ..analysis.lockdep import named_lock
 
 logger = get_logger("cluster")
 
@@ -111,7 +112,7 @@ class ClusterMap:
             env_float("THEIA_CLUSTER_PEER_TIMEOUT", 5.0)
             if peer_timeout is None else float(peer_timeout))
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = named_lock("cluster.map")
         #: peer -> (last success monotonic, last ping doc)
         self._seen: Dict[str, Tuple[float, Dict[str, object]]] = {}
         self._last_err: Dict[str, str] = {}

@@ -50,6 +50,7 @@ from .replication import (
 )
 from .router import IngestRouter, RouterForwardError
 from .transport import ClusterTransport, PeerUnreachable
+from ..analysis.lockdep import named_lock
 
 logger = get_logger("cluster")
 
@@ -95,6 +96,9 @@ class ClusterNode:
                    or parsed[0][0])
         kwargs = {} if clock is None else {"clock": clock}
         self.cmap = ClusterMap(parsed, self_id, **kwargs)
+        # the bounds-scan throttle rides the same injectable clock as
+        # the heartbeat loop (tests step it without sleeping)
+        self._clock = clock if clock is not None else time.monotonic
         self.db = db
         self.ingest = ingest
         self.role = role if role is not None else default_role()
@@ -115,7 +119,7 @@ class ClusterNode:
             "THEIA_CLUSTER_BOUNDS_INTERVAL", 5.0)
         self.transport = ClusterTransport(self.cmap, token=token,
                                           ca_cert=ca_cert)
-        self._lock = threading.Lock()
+        self._lock = named_lock("cluster.node")
         self.leader: Optional[ReplicationLeader] = None
         self.follower: Optional[FollowerApplier] = None
         self.router: Optional[IngestRouter] = None
@@ -277,7 +281,7 @@ class ClusterNode:
                 cached["tables"] = tfp
                 self._store_doc_cache = cached
             return cached
-        now = time.monotonic()
+        now = self._clock()
         if cached is not None and \
                 now - self._store_doc_at < self._bounds_interval:
             return {"fingerprint": fp, "tables": tfp}

@@ -49,6 +49,7 @@ from ..utils.backoff import capped_backoff
 from ..utils.env import env_float, env_int
 from ..utils.logging import get_logger
 from .transport import PeerUnreachable
+from ..analysis.lockdep import named_condition, named_lock
 
 logger = get_logger("cluster")
 
@@ -220,7 +221,7 @@ class ReplicationLeader:
         self.idle_wait = idle_wait
         self.dedup_dump = dedup_dump
         self._clock = clock
-        self._cond = threading.Condition()
+        self._cond = named_condition("repl.leader")
         self._followers: Dict[str, _Follower] = {
             p: _Follower(p) for p in followers}
         self._stop = threading.Event()
@@ -497,7 +498,7 @@ class FollowerApplier:
             env_float("THEIA_REPL_MAX_STALENESS", 30.0)
             if max_staleness is None else float(max_staleness))
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = named_lock("repl.follower")
         self.leader_lsn = 0
         self.leader_term = 0
         self.leader_id: Optional[str] = None

@@ -39,6 +39,7 @@ from ..obs import metrics as _metrics
 from ..obs import trace as _trace
 from ..utils.env import env_int
 from ..utils.logging import get_logger
+from ..analysis.lockdep import named_lock
 
 logger = get_logger("cluster")
 
@@ -83,7 +84,7 @@ class IngestRouter:
                              if max_attempts is None
                              else int(max_attempts))
         self._clients: Dict[str, object] = {}
-        self._clients_lock = threading.Lock()
+        self._clients_lock = named_lock("router.clients")
         self._pool = ThreadPoolExecutor(
             max_workers=max(2, 2 * len(cmap.order)),
             thread_name_prefix="theia-router")

@@ -50,6 +50,7 @@ from typing import Dict, List, Optional, Tuple
 from ..obs import trace as _trace
 from ..utils.faults import fire as _fire_fault
 from ..utils.logging import get_logger
+from ..analysis.lockdep import named_lock
 
 logger = get_logger("cluster")
 
@@ -93,7 +94,7 @@ class ClusterTransport:
         self._ctx = (ssl.create_default_context(cafile=ca_cert)
                      if ca_cert else None)
         self._idle: Dict[str, List[http.client.HTTPConnection]] = {}
-        self._idle_lock = threading.Lock()
+        self._idle_lock = named_lock("transport.idle")
         self._closed = False
 
     # -- connection pool ---------------------------------------------------

@@ -24,6 +24,8 @@ import ctypes
 import os
 import subprocess
 import threading
+
+from ..analysis.lockdep import named_lock
 from typing import Dict, Optional
 
 import numpy as np
@@ -55,7 +57,7 @@ def _so_path() -> str:
             h.update(f.read())
     return os.path.join(_BUILD_DIR, f"flowblock-{h.hexdigest()[:12]}.so")
 
-_lib_lock = threading.Lock()
+_lib_lock = named_lock("native.lib")
 _lib: Optional[ctypes.CDLL] = None
 _build_error: Optional[str] = None
 

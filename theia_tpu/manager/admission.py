@@ -87,6 +87,7 @@ from ..utils.env import env_float, env_int
 from ..utils.faults import FaultError
 from ..utils.faults import fire as _fire_fault
 from ..utils.logging import get_logger
+from ..analysis.lockdep import named_lock
 
 logger = get_logger("admission")
 
@@ -152,7 +153,7 @@ class TokenBucket:
         self._clock = clock
         self._tokens = self.burst
         self._t = clock()
-        self._lock = threading.Lock()
+        self._lock = named_lock("admission.bucket")
 
     def _refill_locked(self) -> None:
         now = self._clock()
@@ -229,7 +230,7 @@ class DedupWindow:
         self._streams: "collections.OrderedDict[str, collections.OrderedDict[int, int]]" = (
             collections.OrderedDict())
         self._entries = 0
-        self._lock = threading.Lock()
+        self._lock = named_lock("admission.dedup")
         self.hits = 0
         self.misses = 0
         self.evicted_streams = 0
@@ -364,7 +365,7 @@ class AdmissionController:
             if retry_after_hint is None else float(retry_after_hint))
         #: name -> (current-value callable, high watermark)
         self._signals: Dict[str, Tuple[Callable[[], float], float]] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("admission.controller")
         self._level = LEVEL_OK
         self._level_since = clock()
         #: first time pressure was seen below the de-escalation

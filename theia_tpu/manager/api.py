@@ -67,6 +67,7 @@ from .. import __version__
 from ..store import AllReplicasDownError, ReplicatedFlowDatabase
 from ..utils import dump_logs, get_logger
 from ..utils import faults as _faults
+from ..analysis.lockdep import named_lock
 
 logger = get_logger("apiserver")
 
@@ -283,6 +284,53 @@ def refresh_scrape_gauges(controller, ingest, retention) -> None:
             labelnames=("tier",))
         pb.labels(tier="hot").set(parts["hotBytes"])
         pb.labels(tier="cold").set(parts["coldBytes"])
+    _refresh_lockdep_gauges()
+
+
+def _refresh_lockdep_gauges() -> None:
+    """Lockdep witness exposition (armed runs only): aggregate graph
+    gauges plus per-lock cumulative stats. Values come from the
+    witness's own accounting at scrape time — the hot path never
+    touches the metrics registry for these."""
+    from ..analysis import lockdep as _lockdep
+    if not _lockdep.enabled():
+        return
+    stats = _lockdep.stats()
+    _obs_metrics.gauge(
+        "theia_lockdep_locks",
+        "Lock classes the lockdep witness is tracking").set(
+        len(_lockdep.lock_names()))
+    _obs_metrics.gauge(
+        "theia_lockdep_edges",
+        "Distinct blocking acquisition-order edges observed").set(
+        len(_lockdep.order_edges()))
+    _obs_metrics.gauge(
+        "theia_lockdep_inversions",
+        "Lock-order inversions witnessed since start (any nonzero "
+        "value is a latent deadlock)").set(
+        len(_lockdep.inversions()))
+    acq = _obs_metrics.gauge(
+        "theia_lockdep_acquires_total",
+        "Witnessed lock acquisitions by lock class (cumulative; "
+        "scrape-time snapshot of the witness counters)",
+        labelnames=("lock",))
+    con = _obs_metrics.gauge(
+        "theia_lockdep_contended_total",
+        "Witnessed acquisitions that had to wait, by lock class",
+        labelnames=("lock",))
+    wai = _obs_metrics.gauge(
+        "theia_lockdep_wait_seconds_total",
+        "Cumulative seconds spent waiting for each lock class",
+        labelnames=("lock",))
+    hol = _obs_metrics.gauge(
+        "theia_lockdep_hold_seconds_total",
+        "Cumulative seconds each lock class was held",
+        labelnames=("lock",))
+    for name, s in stats.items():
+        acq.labels(lock=name).set(s["acquires"])
+        con.labels(lock=name).set(s["contended"])
+        wai.labels(lock=name).set(s["waitTotalSeconds"])
+        hol.labels(lock=name).set(s["holdTotalSeconds"])
 
 
 class ManagerAPIHandler(BaseHTTPRequestHandler):
@@ -560,6 +608,16 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
             self._require_auth()
             limit = int(self._query().get("limit", "256"))
             self._send_json(self._parts_debug_doc(limit))
+            return
+        if parts == ("debug", "locks"):
+            # Lockdep witness at inspection depth (`theia locks`):
+            # per-lock contention/hold stats, observed order edges
+            # with first-seen sites, inversions. Sites name source
+            # files and the stats narrate traffic shape — token-gated
+            # like the other /debug surfaces.
+            self._require_auth()
+            from ..analysis import lockdep as _lockdep
+            self._send_json(_lockdep.stats_doc())
             return
         if parts == ("debug", "views"):
             # Declared rollup views at inspection depth (`theia
@@ -1210,7 +1268,7 @@ class _TLSCapableServer(ThreadingHTTPServer):
 
     def __init__(self, *args, **kwargs) -> None:
         self._conns: set = set()
-        self._conns_lock = threading.Lock()
+        self._conns_lock = named_lock("api.conns")
         super().__init__(*args, **kwargs)
 
     def process_request(self, request, client_address):

@@ -13,6 +13,7 @@ import threading
 from typing import Dict, Optional
 
 from ..utils import get_logger
+from ..analysis.lockdep import named_lock
 
 logger = get_logger("collect")
 
@@ -30,7 +31,7 @@ class AsyncCollector:
         self.status = "none"
         self._data: Optional[bytes] = None
         self._error = ""
-        self._lock = threading.Lock()
+        self._lock = named_lock("manager.collect")
 
     def _collect(self, *args) -> bytes:
         raise NotImplementedError

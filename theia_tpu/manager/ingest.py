@@ -66,6 +66,8 @@ import collections
 import dataclasses
 import os
 import threading
+
+from ..analysis.lockdep import named_lock
 import time
 import zlib
 from concurrent.futures import ThreadPoolExecutor
@@ -173,7 +175,7 @@ class StreamCapacityError(Exception):
 class _Stream:
     def __init__(self) -> None:
         self.decoder = TsvDecoder()
-        self.lock = threading.Lock()
+        self.lock = named_lock("ingest.stream")
         self.last_used = time.monotonic()
 
 
@@ -190,7 +192,7 @@ class DetectorShard:
         self.index = index
         self.heavy = heavy
         self.streaming = streaming
-        self.lock = threading.Lock()
+        self.lock = named_lock("ingest.shard")
 
 
 class IngestManager:
@@ -231,7 +233,7 @@ class IngestManager:
                  ) -> None:
         self.db = db
         self._streams: Dict[str, _Stream] = {}
-        self._registry_lock = threading.Lock()
+        self._registry_lock = named_lock("ingest.registry")
         # Injected detector instances pin the manager to ONE shard
         # (there is a single state table to keep coherent); otherwise
         # detector state shards n_shards ways.
@@ -275,7 +277,7 @@ class IngestManager:
                     n, stripe=stripe))
         # The alert ring has its own cheap lock: GET /alerts never
         # waits behind scoring or JIT compilation.
-        self._alerts_lock = threading.Lock()
+        self._alerts_lock = named_lock("ingest.alerts")
         self._alerts: Deque[Dict[str, object]] = collections.deque(
             maxlen=MAX_ALERTS)
         self.rows_ingested = 0
@@ -288,7 +290,7 @@ class IngestManager:
         # producers. The remap has its OWN fine-grained lock so dict
         # maintenance for one batch never blocks another batch's
         # shard scoring.
-        self._dict_lock = threading.Lock()
+        self._dict_lock = named_lock("ingest.dict")
         self._global_dicts: Dict[str, StringDictionary] = {
             c: StringDictionary() for c in self.GLOBAL_COLUMNS}
         self._mappers: Dict[str, DictionaryMapper] = {
@@ -315,7 +317,7 @@ class IngestManager:
         # them with a BOUND (ThreadPoolExecutor.shutdown(wait=True)
         # has none, and one wedged insert must not hang SIGTERM
         # forever past the WAL-fsync/final-checkpoint steps).
-        self._inflight_lock = threading.Lock()
+        self._inflight_lock = named_lock("ingest.inflight")
         self._inflight: set = set()
         # -- overload-control plane (manager/admission.py) -----------
         # Explicit backlog bound: the insert pool's queue used to grow
@@ -365,7 +367,7 @@ class IngestManager:
         # second copy, and must not re-apply the block's dictionary
         # delta; it is answered 429 and finds duplicate:true once the
         # original acks.
-        self._pending_lock = threading.Lock()
+        self._pending_lock = named_lock("ingest.pending")
         self._pending: set = set()
         # Decoded-but-unacknowledged batches parked by a post-decode
         # failure (replication-quorum timeout, forwarded-slice
@@ -376,7 +378,7 @@ class IngestManager:
         # parked decoded batch instead. One entry per stream (a
         # producer retries its failed block before sending the next),
         # bounded, cleared on success.
-        self._parked_lock = threading.Lock()
+        self._parked_lock = named_lock("ingest.parked")
         self._parked: "collections.OrderedDict[str, Tuple[int, ColumnarBatch]]" = (
             collections.OrderedDict())
         recovered = getattr(db, "recovered_acks", None)

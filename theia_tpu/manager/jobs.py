@@ -63,6 +63,7 @@ from ..utils.backoff import capped_backoff
 from ..utils.env import env_float, env_int
 from ..utils.faults import FaultError
 from ..utils.faults import fire as _fire_fault
+from ..analysis.lockdep import named_lock
 
 logger = get_logger("jobs")
 
@@ -218,9 +219,9 @@ class JobController:
         self.alert_sink = alert_sink
         # One job owns the accelerator at a time in subprocess mode:
         # two children would interleave compilations and thrash HBM.
-        self._device_lock = threading.Lock()
+        self._device_lock = named_lock("jobs.device")
         self._records: Dict[str, JobRecord] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("jobs.controller")
         #: job name → (Timer, record) for retries waiting out their
         #: backoff; cancelled (and the records failed) on shutdown
         self._retry_timers: Dict[str, tuple] = {}

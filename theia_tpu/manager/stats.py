@@ -22,6 +22,7 @@ import traceback
 from typing import Dict, List
 
 from ..store import FlowDatabase
+from ..analysis.lockdep import named_lock
 
 
 class StatsProvider:
@@ -31,7 +32,7 @@ class StatsProvider:
         self.db = db
         self.capacity_bytes = capacity_bytes
         self.shard = shard
-        self._lock = threading.Lock()
+        self._lock = named_lock("manager.stats")
         self._last_sample = (time.time(), self._row_byte_totals())
 
     def _row_byte_totals(self):

@@ -36,8 +36,9 @@ Env knobs:
                              disable()/enable()
 
 This module deliberately imports nothing from the rest of theia_tpu
-(stdlib + numpy only): utils.faults instruments its firings here, and
-utils is imported by everything.
+(stdlib + numpy only, plus analysis.lockdep — itself stdlib-only, so
+its own locks are witnessed too): utils.faults instruments its
+firings here, and utils is imported by everything.
 """
 
 from __future__ import annotations
@@ -48,6 +49,7 @@ import threading
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
+from ..analysis.lockdep import named_lock
 
 
 def _env_int(name: str, default: int) -> int:
@@ -106,7 +108,7 @@ class _Metric:
         self.help = help_text
         self.labelnames = tuple(labelnames)
         self._children: Dict[Tuple[str, ...], object] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("metrics.children")
         self._default = self._make_child() if not self.labelnames \
             else None
 
@@ -139,7 +141,7 @@ class _CounterChild:
 
     def __init__(self) -> None:
         self._stripes = np.zeros(N_STRIPES + 1, np.float64)
-        self._lock = threading.Lock()
+        self._lock = named_lock("metrics.counter")
 
     def inc(self, amount: float = 1.0,
             stripe: Optional[int] = None) -> None:
@@ -184,7 +186,7 @@ class _GaugeChild:
 
     def __init__(self) -> None:
         self._value = 0.0
-        self._lock = threading.Lock()
+        self._lock = named_lock("metrics.gauge")
         self._callback: Optional[Callable[[], float]] = None
 
     def set(self, value: float) -> None:
@@ -271,7 +273,7 @@ class _HistogramChild:
                                 np.int64)
         self._sums = np.zeros(N_STRIPES + 1, np.float64)
         self._ns = np.zeros(N_STRIPES + 1, np.int64)
-        self._lock = threading.Lock()
+        self._lock = named_lock("metrics.histogram")
 
     def observe(self, value: float,
                 stripe: Optional[int] = None) -> None:
@@ -335,7 +337,7 @@ class Registry:
 
     def __init__(self) -> None:
         self._metrics: Dict[str, _Metric] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("metrics.registry")
 
     def _get_or_make(self, cls, name: str, help_text: str,
                      labelnames: Tuple[str, ...]):

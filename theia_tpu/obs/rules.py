@@ -80,6 +80,7 @@ from typing import Callable, Dict, List, Optional
 from ..schema import METRICS_VALUE_SCALE
 from ..utils.logging import get_logger
 from . import metrics as _metrics
+from ..analysis.lockdep import named_lock
 
 logger = get_logger("obs.rules")
 
@@ -248,7 +249,7 @@ class RulesEngine:
         self.loaded_at: Optional[float] = None
         self._mtime: Optional[float] = None
         self._states: Dict[tuple, _SeriesState] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("rules.engine")
         self.evaluations = 0
         self.transitions = 0
         self.reload()

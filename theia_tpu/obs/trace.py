@@ -58,6 +58,7 @@ import time
 from typing import Deque, Dict, List, Optional
 
 from . import metrics as _metrics
+from ..analysis.lockdep import named_lock
 
 
 def _ring_capacity() -> int:
@@ -83,7 +84,7 @@ def _sample_rate(env: Optional[str] = None) -> float:
 #: this, new op names are recorded in the ring but not as exemplars)
 MAX_EXEMPLAR_OPS = 128
 
-_lock = threading.Lock()
+_lock = named_lock("trace.ring")
 _ring: Deque[Dict[str, object]] = collections.deque(
     maxlen=_ring_capacity())
 _slowest: Dict[str, Dict[str, object]] = {}

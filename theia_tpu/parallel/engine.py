@@ -25,8 +25,9 @@ from typing import Callable, Dict, Optional, Tuple
 import jax
 
 from .mesh import Mesh, make_mesh
+from ..analysis.lockdep import named_lock
 
-_lock = threading.Lock()
+_lock = named_lock("parallel.engine")
 _cache: Dict[str, Optional[Mesh]] = {}
 # Jitted shard_map builders are cached per (mesh, kernel, params): the
 # builders close over the mesh and re-running them would re-trace.

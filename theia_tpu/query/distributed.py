@@ -86,6 +86,7 @@ from .engine import (
 from .explain import SLOW_QUERIES, QueryProfiler
 from .plan import QUERYABLE_TABLES, QueryPlan
 from .result import empty_result, finalize, lower_specs
+from ..analysis.lockdep import named_lock
 
 logger = get_logger("query.distributed")
 
@@ -277,7 +278,7 @@ class ClusterQueryCoordinator:
         self.workers = max(2, len(self.cmap.order) - 1)
         self.fanouts = 0
         self.partial_results = 0
-        self._lock = threading.Lock()
+        self._lock = named_lock("query.coordinator")
 
     # -- execution ---------------------------------------------------------
 

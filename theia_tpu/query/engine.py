@@ -81,6 +81,7 @@ from .explain import SLOW_QUERIES, QueryProfiler
 from .plan import QUERYABLE_TABLES, QueryPlan
 from .reference import filter_mask, materialize_keys, reference_partial
 from .result import empty_result, finalize, lower_specs, value_columns
+from ..analysis.lockdep import named_lock
 
 logger = get_logger("query")
 
@@ -299,7 +300,7 @@ class QueryCache:
         self._entries: "collections.OrderedDict[tuple, Tuple[dict, int]]" = (
             collections.OrderedDict())
         self._bytes = 0
-        self._lock = threading.Lock()
+        self._lock = named_lock("query.cache")
         self.hits = 0
         self.misses = 0
 
@@ -387,7 +388,7 @@ class QueryEngine:
         self._cold_sem = threading.Semaphore(self.cold_buffer)
         self.cache = QueryCache(cache_bytes)
         self.queries = 0
-        self._lock = threading.Lock()
+        self._lock = named_lock("query.engine")
 
     # -- store resolution --------------------------------------------------
 

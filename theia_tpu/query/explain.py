@@ -37,6 +37,7 @@ from typing import Deque, Dict, List, Optional
 
 from ..obs import metrics as _metrics
 from ..utils.env import env_int
+from ..analysis.lockdep import named_lock
 
 _M_SLOW = _metrics.counter(
     "theia_query_slow_queries_total",
@@ -74,7 +75,7 @@ class QueryProfiler:
         self.memtable_rows = 0
         self.phases: Dict[str, float] = {}
         self.peers: List[Dict[str, object]] = []
-        self._lock = threading.Lock()
+        self._lock = named_lock("query.profiler")
 
     @staticmethod
     def maybe(explain: bool) -> Optional["QueryProfiler"]:
@@ -162,7 +163,7 @@ class SlowQueryLog:
                if capacity is None else int(capacity))
         self._ring: Deque[Dict[str, object]] = collections.deque(
             maxlen=max(0, cap))
-        self._lock = threading.Lock()
+        self._lock = named_lock("query.slowlog")
         self.captured = 0
 
     def capture(self, plan, doc: Dict[str, object],

@@ -35,6 +35,7 @@ from typing import Dict, Optional, Sequence, Tuple
 import numpy as np
 
 from ..utils.logging import get_logger
+from ..analysis.lockdep import named_lock
 
 logger = get_logger("query.kernels")
 
@@ -42,7 +43,7 @@ logger = get_logger("query.kernels")
 #: sum of partial counts; everything else merges with its own op)
 MERGE_OP = {"count": "sum", "sum": "sum", "min": "min", "max": "max"}
 
-_jax_state_lock = threading.Lock()
+_jax_state_lock = named_lock("query.jax_state")
 _jax_disabled_reason: Optional[str] = None
 
 

@@ -98,6 +98,7 @@ from .plan import (Aggregate, Filter, PlanError, QueryPlan,
                    _parse_aggregate, _parse_filter)
 from .reference import filter_mask, materialize_keys
 from .result import lower_specs
+from ..analysis.lockdep import named_lock
 
 logger = get_logger("rollup")
 
@@ -614,7 +615,7 @@ class RollupManager:
         self.load_error: Optional[str] = None
         self.loaded_at: Optional[float] = None
         self._mtime: Optional[float] = None
-        self._lock = threading.Lock()
+        self._lock = named_lock("rollup.manager")
         #: per-view LOW WATERMARK (a bucket-aligned timestamp): a
         #: TTL/retention trim drops every rollup bucket below it and
         #: advances it; the planner serves [watermark, ...) from the

@@ -16,6 +16,7 @@ import time
 from typing import List, Optional
 
 from ..utils import atomic_write
+from ..analysis.lockdep import named_lock
 
 
 class JobProgress:
@@ -35,7 +36,7 @@ class JobProgress:
         self._error = ""
         self._current = ""
         self._started = time.time()
-        self._lock = threading.Lock()
+        self._lock = named_lock("runner.progress")
         self._flush()
 
     def stage(self, name: str) -> None:

@@ -20,6 +20,7 @@ import threading
 from typing import Dict, Iterable, List, Mapping, Optional, Sequence
 
 import numpy as np
+from ..analysis.lockdep import named_lock
 
 
 class StringDictionary:
@@ -35,7 +36,7 @@ class StringDictionary:
     def __init__(self) -> None:
         self._to_code: Dict[str, int] = {"": 0}
         self._strings: List[str] = [""]
-        self._lock = threading.Lock()
+        self._lock = named_lock("schema.dict")
 
     def __len__(self) -> int:
         return len(self._strings)

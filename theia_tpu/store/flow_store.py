@@ -64,6 +64,7 @@ from ..utils.faults import fire as _fire_fault
 from ..utils.logging import get_logger
 from ..utils.pool import get_pool
 from .views import MATERIALIZED_VIEWS, ViewTable
+from ..analysis.lockdep import named_lock
 
 _logger = get_logger("store")
 
@@ -127,7 +128,7 @@ class Table:
         self.dicts: Dict[str, StringDictionary] = {
             c.name: StringDictionary() for c in schema if c.is_string}
         self._batches: List[ColumnarBatch] = []
-        self._lock = threading.Lock()
+        self._lock = named_lock("store.table")
         #: monotonic mutation counter (inserts AND deletes) — the
         #: checkpointer's change detector; row counts alone can't see
         #: same-size churn (TTL evicts N, ingest adds N)
@@ -144,7 +145,7 @@ class Table:
         # per-block store overhead of BENCH_r04).
         self._adopt_maps: Dict[str, DictionaryMapper] = {
             name: DictionaryMapper(d) for name, d in self.dicts.items()}
-        self._adopt_lock = threading.Lock()
+        self._adopt_lock = named_lock("store.table_adopt")
         # Cached per-batch (min, max) of the time column, aligned with
         # _batches: TTL's min_value() probe runs per insert and the
         # retention boundary runs per monitor round — both become
@@ -799,7 +800,8 @@ class FlowDatabase:
             # its view apply — a row ≤ the stamp would then be
             # missing from the recovered views forever.
             from .wal import _Latch
-            self._ingest_latch: Optional[object] = _Latch()
+            self._ingest_latch: Optional[object] = _Latch(
+                "store.ingest_latch")
         else:
             self.flows = Table("flows", FLOW_SCHEMA)
             self._ingest_latch = None

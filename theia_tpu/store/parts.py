@@ -115,6 +115,7 @@ from ..utils.env import env_float, env_int
 from ..utils.logging import get_logger
 from . import wal as _wal
 from .flow_store import Table
+from ..analysis.lockdep import named_lock
 
 logger = get_logger("parts")
 
@@ -669,7 +670,7 @@ class PartTable(Table):
         #: must not orphan a concurrent append — an entry lost here is
         #: a manifest referencing a never-fsynced file.
         self._pending_fsync: List[str] = []
-        self._fsync_lock = threading.Lock()
+        self._fsync_lock = named_lock("parts.fsync")
         #: basenames of files created but possibly not yet reachable
         #: through _parts (a merge building its replacement part) —
         #: the GC keep-set includes them so a concurrent save cannot

@@ -50,6 +50,7 @@ from ..utils import get_logger
 from ..utils.backoff import capped_backoff
 from ..utils.faults import fire as _fire_fault
 from .flow_store import FlowDatabase
+from ..analysis.lockdep import named_lock
 
 logger = get_logger("replicated")
 
@@ -186,12 +187,12 @@ class ReplicatedFlowDatabase:
         #: failedWrites}; a subset of _down. Manual set_replica_down
         #: marks never appear here, so the repair loop leaves them be.
         self._quarantined: Dict[int, Dict[str, object]] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("store.replicated")
         # Serializes fan-out writes against each other (deterministic
         # per-replica apply order) and — critically — against resync:
         # without it a write landing between the resync copy and the
         # up-mark would be missing from the recovered replica forever.
-        self._write_lock = threading.Lock()
+        self._write_lock = named_lock("store.replicated_write")
         self.result_tables: Dict[str, _ReplicatedTable] = {
             name: _ReplicatedTable(self, name)
             for name in self.replicas[0].result_tables}

@@ -36,6 +36,7 @@ from ..utils.logging import get_logger
 from ..utils.pool import get_pool
 from .flow_store import FlowDatabase, RetentionMonitor, write_snapshot
 from .views import MATERIALIZED_VIEWS, group_sum, materialize_view_batch
+from ..analysis.lockdep import named_lock
 
 _logger = get_logger("sharded")
 
@@ -54,7 +55,7 @@ class DistributedTable:
         self.name = name
         self.tables = list(tables)
         self._rng = rng
-        self._lock = threading.Lock()
+        self._lock = named_lock("store.sharded")
 
     @property
     def schema(self):

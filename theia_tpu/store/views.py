@@ -25,6 +25,7 @@ from typing import Dict, List, Tuple
 import numpy as np
 
 from ..schema import ColumnarBatch, StringDictionary
+from ..analysis.lockdep import named_lock
 
 
 def group_reduce(keys: np.ndarray, values: np.ndarray, op: str = "sum"
@@ -174,7 +175,7 @@ class ViewTable:
         # read-time lexsort compaction); group_sum_fast parts are not —
         # a 64-bit row-hash collision can split one key across rows.
         self._parts: List[Tuple[np.ndarray, np.ndarray, bool]] = []
-        self._lock = threading.Lock()
+        self._lock = named_lock("store.view")
 
     def __len__(self) -> int:
         keys, _ = self._merged()

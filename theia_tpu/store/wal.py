@@ -98,6 +98,8 @@ from ..schema import ColumnarBatch, StringDictionary
 from ..utils.env import env_int
 from ..utils.faults import fire as _fire_fault
 from ..utils.logging import get_logger
+from ..analysis import lockdep as _lockdep
+from ..analysis.lockdep import named_lock
 
 logger = get_logger("wal")
 
@@ -478,19 +480,43 @@ class _Latch:
     across LSN stamp + table scan), so the stamp exactly partitions
     records into in-snapshot vs to-replay. Writers do not exclude each
     other (snapshots are serialized by the Checkpointer; a racing
-    manual save just reads the same consistent state)."""
+    manual save just reads the same consistent state).
 
-    def __init__(self) -> None:
+    The latch participates in the lockdep witness as a single named
+    region (both sides map to `name`): a reader holding the latch and
+    acquiring lock X, plus an X-holder waiting on the write side, is a
+    real deadlock the moment a writer is pending — the PR-14 class —
+    so read and write acquisitions both record order edges."""
+
+    def __init__(self, name: str = "wal.latch") -> None:
+        # inner coordination Condition stays bare: the witness tracks
+        # the latch as one region, not its implementation detail
         self._cond = threading.Condition()
         self._readers = 0
         self._writers = 0
+        self.name = name
+        self._witness = _lockdep.enabled()
+        if self._witness:
+            _lockdep.register_name(name)
 
     @contextlib.contextmanager
     def read(self):
+        if self._witness:
+            # order validation BEFORE blocking: a raise-mode
+            # inversion must propagate with the latch untouched
+            _lockdep.check_before_acquire(self, self.name)
+        t0 = time.monotonic() if self._witness else 0.0
         with self._cond:
+            waited = False
             while self._writers:
+                waited = True
                 self._cond.wait()
             self._readers += 1
+        if self._witness:
+            _lockdep.note_acquire(
+                self, self.name, blocking=True,
+                wait=time.monotonic() - t0 if waited else 0.0,
+                contended=waited)
         try:
             yield
         finally:
@@ -498,19 +524,33 @@ class _Latch:
                 self._readers -= 1
                 if self._readers == 0:
                     self._cond.notify_all()
+            if self._witness:
+                _lockdep.note_release(self, self.name)
 
     @contextlib.contextmanager
     def write(self):
+        if self._witness:
+            _lockdep.check_before_acquire(self, self.name)
+        t0 = time.monotonic() if self._witness else 0.0
         with self._cond:
             self._writers += 1
+            waited = False
             while self._readers:
+                waited = True
                 self._cond.wait()
+        if self._witness:
+            _lockdep.note_acquire(
+                self, self.name, blocking=True,
+                wait=time.monotonic() - t0 if waited else 0.0,
+                contended=waited)
         try:
             yield
         finally:
             with self._cond:
                 self._writers -= 1
                 self._cond.notify_all()
+            if self._witness:
+                _lockdep.note_release(self, self.name)
 
 
 # -- the log --------------------------------------------------------------
@@ -538,8 +578,8 @@ class WriteAheadLog:
         if self.segment_bytes < 4096:
             self.segment_bytes = 4096
         self._clock = clock
-        self._io = threading.Lock()
-        self._latch = _Latch()
+        self._io = named_lock("wal.io")
+        self._latch = _Latch("wal.latch")
         self._file = None
         self._seg_path: Optional[str] = None
         self._seg_size = 0

@@ -83,8 +83,30 @@ import time
 from typing import Dict, List, Optional
 
 from ..obs import metrics as _metrics
+from ..analysis.lockdep import named_lock
 
 MODES = ("error", "hang")
+
+#: The fault-site registry: every `fire("<site>")` literal in the
+#: package must name a member, and every member must be fired
+#: somewhere — both directions enforced by the static lint pass
+#: (theia_tpu/analysis/lint.py), so a renamed or removed site cannot
+#: silently strand the operator docs above or a drill script.
+KNOWN_SITES = (
+    "store.insert",
+    "replica.write",
+    "checkpoint.save",
+    "wal.append",
+    "wal.fsync",
+    "wal.rotate",
+    "runner.spawn",
+    "runner.exec",
+    "reconciler.pass",
+    "net.send",
+    "net.recv",
+    "peer.partition",
+    "admission.pressure",
+)
 
 _M_FIRINGS = _metrics.counter(
     "theia_fault_firings_total",
@@ -170,7 +192,7 @@ class FaultInjector:
             if hang_seconds is None else float(hang_seconds))
         self._rng = random.Random(seed)
         self._counts: Dict[str, int] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("faults.injector")
         self._release = threading.Event()
 
     def counts(self) -> Dict[str, int]:

@@ -16,10 +16,11 @@ import sys
 import threading
 import time
 from typing import Deque, Optional
+from ..analysis.lockdep import named_lock
 
 _RING_CAPACITY = 5000
 
-_lock = threading.Lock()
+_lock = named_lock("utils.logging")
 _verbosity = 0
 _ring: Deque[str] = collections.deque(maxlen=_RING_CAPACITY)
 

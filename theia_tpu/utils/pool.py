@@ -13,6 +13,7 @@ import threading
 from typing import Dict
 
 from .logging import get_logger
+from ..analysis.lockdep import named_lock
 
 logger = get_logger("pool")
 
@@ -21,7 +22,7 @@ _sizes: Dict[str, int] = {}
 #: (name, requested) pairs already warned about — one log line per
 #: distinct mismatch, not one per call on a hot path
 _warned: set = set()
-_lock = threading.Lock()
+_lock = named_lock("utils.pool")
 
 
 def get_pool(name: str,
