@@ -720,11 +720,11 @@ def test_ingest_client_honors_retry_after(server, monkeypatch):
     real_admit = server.ingest.admission.admit
     calls = {"n": 0}
 
-    def admit_once_rejected(stream, nbytes):
+    def admit_once_rejected(stream, nbytes, **kw):
         calls["n"] += 1
         if calls["n"] == 1:
             raise AdmissionRejected("pressure", 0.25, "drill")
-        return real_admit(stream, nbytes)
+        return real_admit(stream, nbytes, **kw)
     monkeypatch.setattr(server.ingest.admission, "admit",
                         admit_once_rejected)
     out = client.send(enc.encode(batch))
@@ -793,7 +793,7 @@ def test_ingest_client_no_sleep_after_final_attempt(server,
 
     from theia_tpu.ingest.client import IngestClient, IngestError
 
-    def always_reject(stream, nbytes):
+    def always_reject(stream, nbytes, **kw):
         raise AdmissionRejected("pressure", 0.2, "drill")
     monkeypatch.setattr(server.ingest.admission, "admit",
                         always_reject)

@@ -627,10 +627,12 @@ def ingest_cmd(args) -> None:
     with jittered capped backoff) — the operator's load/drill tool
     and the smallest correct producer to crib from."""
     from ..data.synth import SynthConfig, generate_flows
-    from ..ingest import BlockEncoder
+    from ..ingest import make_block_encoder
     from ..ingest.client import IngestClient, IngestError
 
-    enc = BlockEncoder()
+    # TBLK by default; THEIA_INGEST_FORMAT=tfb2 keeps the legacy
+    # dictionary-delta stream for drills against old managers
+    enc = make_block_encoder()
     batch = generate_flows(SynthConfig(
         n_series=args.series, points_per_series=args.points,
         anomaly_fraction=args.anomaly_fraction, seed=args.seed),

@@ -3,7 +3,12 @@ quorum acks, and part-manifest catch-up resync.
 
 The PR-4 WAL was built self-contained (records carry their own string
 dictionaries) precisely so a log written on one node replays on
-another; this module ships it. One shipper thread per follower reads
+another; this module ships it. Because frames ship byte-for-byte, the
+TBLK zero-copy ingest path composes for free: a record whose body is
+the producer's received column section journaled verbatim
+(store/wire.py) replicates as those same bytes — the leader never
+re-encodes, and the follower's log stays a byte-identical
+continuation. One shipper thread per follower reads
 raw frames from the leader's on-disk log above the follower's acked
 LSN and POSTs them to the follower's `/cluster/replicate`; the
 follower appends them VERBATIM to its own log (leader LSNs preserved —

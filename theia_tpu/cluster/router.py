@@ -144,6 +144,62 @@ class IngestRouter:
         _M_LOCAL_ROWS.inc(len(local))
         return local, out
 
+    def split_wire(self, wire) -> Optional[Tuple[bytes, List[
+            Tuple[str, bytes, int]]]]:
+        """Split an ENCODED TBLK column section by owner WITHOUT a
+        full-batch decode: only the destinationIP column (plus its
+        unique-string table) is decoded to compute owners; every slice
+        — remote and local — is then cut by column GATHER on the
+        encoded bytes (store/wire.py), so a 52-column batch never
+        round-trips through decode→take→re-encode just to be
+        forwarded. Remote slices are shipped as self-contained TREC
+        payloads (exactly what `split`+`_send` produce, so owners
+        cannot tell the paths apart).
+
+        Returns None when no routing is needed — single-node mesh,
+        no destination column, or every row already local — in which
+        case the caller decodes the original payload whole; otherwise
+        (local column section bytes, [(peer, TREC payload, rows)]).
+
+        The owner LUT is keyed per dictionary; TBLK blocks carry fresh
+        per-block dictionaries, so unlike the TFB2 path the LUT does
+        not amortize across a stream — the per-request cost is one
+        crc32 per unique destination, which the skipped re-encode
+        repays many times over."""
+        n_peers = len(self.cmap.order)
+        if n_peers <= 1:
+            return None
+        from ..store import wire as _wirefmt
+        from ..store.wal import RECORD_MAGIC, pack_table_header
+        sub, end = _wirefmt.decode_columns(
+            wire, 0, columns=frozenset(("destinationIP",)))
+        if end != len(wire):
+            raise _wirefmt.WireCorruption(
+                f"block has {len(wire) - end} trailing bytes")
+        d = sub.dicts.get("destinationIP")
+        if "destinationIP" not in sub.columns or d is None:
+            return None
+        codes = np.asarray(sub["destinationIP"], np.int64)
+        owners = self._owners_for(codes, d)
+        self_i = self.cmap.order.index(self.self_id)
+        if bool(np.all(owners == self_i)):
+            return None
+        thead = RECORD_MAGIC + pack_table_header("flows")
+        remote: List[Tuple[str, bytes, int]] = []
+        for i, peer in enumerate(self.cmap.order):
+            if i == self_i:
+                continue
+            idx = np.flatnonzero(owners == i)
+            if idx.size:
+                parts, _ = _wirefmt.gather_parts(wire, idx)
+                remote.append(
+                    (peer, thead + b"".join(bytes(p) for p in parts),
+                     int(idx.size)))
+        local_idx = np.flatnonzero(owners == self_i)
+        lparts, _ = _wirefmt.gather_parts(wire, local_idx)
+        _M_LOCAL_ROWS.inc(int(local_idx.size))
+        return b"".join(bytes(p) for p in lparts), remote
+
     def _owners_for(self, codes: np.ndarray, d) -> np.ndarray:
         """Owner peer INDEX per row. The per-dictionary LUT caches the
         hash of every code minted so far; dictionaries only grow, so
@@ -185,24 +241,41 @@ class IngestRouter:
                                   ctx)
                 for peer, part in remote]
 
+    def forward_all_wire(self, remote: List[Tuple[str, bytes, int]],
+                         stream: str, seq: Optional[int]) -> List:
+        """`forward_all` for `split_wire` output: the TREC payloads
+        are already cut by column gather, so the pool workers only
+        POST bytes."""
+        sub = self.sub_stream(stream)
+        ctx = _trace.current_context()
+        return [self._pool.submit(self._send_payload, peer, payload,
+                                  rows, sub, seq, ctx)
+                for peer, payload, rows in remote]
+
     def _send(self, peer: str, part, sub_stream: str,
               seq: Optional[int], ctx=None) -> Dict[str, object]:
+        from ..store.wal import RECORD_MAGIC, encode_record_body
+        payload = RECORD_MAGIC + encode_record_body("flows", part)
+        return self._send_payload(peer, payload, len(part),
+                                  sub_stream, seq, ctx)
+
+    def _send_payload(self, peer: str, payload: bytes, n_rows: int,
+                      sub_stream: str, seq: Optional[int],
+                      ctx=None) -> Dict[str, object]:
         import time as _time
 
-        from ..store.wal import RECORD_MAGIC, encode_record_body
         from ..utils.faults import fire as _fire_fault
         # the data plane is part of a partition drill too: a severed
         # link drops forwards exactly like replication and heartbeats
         _fire_fault("net.send", peer=peer, path="/ingest")
         _fire_fault("peer.partition", peer=peer, path="/ingest")
-        payload = RECORD_MAGIC + encode_record_body("flows", part)
         t0 = _time.perf_counter()
         with _trace.child_span("router.forward", ctx, peer=peer,
-                               rows=len(part)):
+                               rows=n_rows):
             out = self._client(peer).send(payload, seq=seq,
                                           stream=sub_stream)
         _M_FWD_SECONDS.observe(_time.perf_counter() - t0)
-        _M_FWD_ROWS.labels(peer=peer).inc(len(part))
+        _M_FWD_ROWS.labels(peer=peer).inc(n_rows)
         _M_FWD_BATCHES.labels(
             result="duplicate" if out.get("duplicate") else "ok").inc()
         return out

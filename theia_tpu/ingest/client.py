@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import http.client
 import json
+import os
 import random
 import ssl
 import time
@@ -69,6 +70,32 @@ def parse_retry_after(headers, body: str) -> float:
         return 1.0
 
 
+def default_ingest_format() -> str:
+    """Producer-side wire format: THEIA_INGEST_FORMAT = `tblk`
+    (default — self-contained columnar blocks, stateless decode) or
+    `tfb2` (the stateful dictionary-delta stream format, kept for
+    mixed fleets and downgrade paths). The server needs no matching
+    knob: it content-negotiates every request by magic bytes."""
+    fmt = (os.environ.get("THEIA_INGEST_FORMAT", "") or "tblk")
+    fmt = fmt.strip().lower()
+    if fmt not in ("tblk", "tfb2"):
+        raise ValueError(
+            f"THEIA_INGEST_FORMAT {fmt!r} is not tblk|tfb2")
+    return fmt
+
+
+def make_block_encoder(fmt: Optional[str] = None, schema=None,
+                       dicts=None):
+    """The one producer-side encoder factory (CLI, bench, tests):
+    returns a `TblkEncoder` or `BlockEncoder` per `fmt` (default:
+    `default_ingest_format()`), both exposing `encode(batch) ->
+    bytes`."""
+    from .native import FLOW_SCHEMA, BlockEncoder, TblkEncoder
+    fmt = fmt or default_ingest_format()
+    cls = TblkEncoder if fmt == "tblk" else BlockEncoder
+    return cls(schema=schema or FLOW_SCHEMA, dicts=dicts)
+
+
 class IngestClient:
     """One producer stream against a manager's POST /ingest.
 
@@ -105,6 +132,7 @@ class IngestClient:
         self._ctx = (ssl.create_default_context(cafile=ca_cert)
                      if ca_cert else None)
         self.seq = 0
+        self._encoder = None   # lazy, built by send_batch()
         # producer-side ledger (the bench/CLI summary surface)
         self.rows_acked = 0
         self.batches_acked = 0
@@ -266,6 +294,17 @@ class IngestClient:
         raise IngestError(
             f"batch seq={seq} not acknowledged after "
             f"{self.max_attempts} attempts (last: {last})")
+
+    def send_batch(self, batch, seq: Optional[int] = None,
+                   stream: Optional[str] = None) -> Dict[str, object]:
+        """Encode a ColumnarBatch ONCE (per THEIA_INGEST_FORMAT) and
+        send it — the producer-side half of the zero-copy path: with
+        the TBLK default these exact column bytes are what admission
+        charges, the router gathers, and the WAL journals."""
+        if self._encoder is None:
+            self._encoder = make_block_encoder()
+        return self.send(self._encoder.encode(batch), seq=seq,
+                         stream=stream)
 
     def request_json(self, method: str, path: str,
                      doc: Optional[Dict] = None,

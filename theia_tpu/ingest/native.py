@@ -32,6 +32,7 @@ import numpy as np
 
 from ..schema import FLOW_SCHEMA, ColumnarBatch, ColumnKind, \
     StringDictionary
+from ..store import wire as _wire
 
 _KIND_CODE = {"int": 0, "float": 1, "string": 2}
 
@@ -571,6 +572,50 @@ class BlockEncoder:
                 parts.append(np.ascontiguousarray(
                     batch[col.name], col.host_dtype).tobytes())
         return b"".join(parts)
+
+
+# TFB3 / "TBLK": the self-contained columnar block format
+# (store/wire.py — the same bytes the WAL journals and parts store).
+# Unlike TFB2 there is NO per-connection dictionary delta chain: every
+# block carries its own batch-unique strings, so blocks from any
+# number of producers decode statelessly, in any order, on any shard —
+# and the receiver journals the column bytes verbatim instead of
+# decode→re-encode. The server content-negotiates per request by
+# magic; THEIA_INGEST_FORMAT picks the producer-side default
+# (ingest/client.py).
+TBLK_MAGIC = _wire.BLOCK_MAGIC
+decode_tblk = _wire.decode_block
+
+
+class TblkEncoder:
+    """Producer side of the TFB3/TBLK block format — `encode(batch)`
+    API-compatible with `BlockEncoder` so producers swap by
+    constructor. Stateless (no delta cursors): one encoder may serve
+    any number of connections concurrently, and a retried block is
+    byte-identical regardless of what was sent in between."""
+
+    def __init__(self, schema=FLOW_SCHEMA,
+                 dicts: Optional[Dict[str, StringDictionary]] = None
+                 ) -> None:
+        self.schema = schema
+        self.dicts = dict(dicts or {})
+        for col in schema:
+            if col.is_string:
+                self.dicts.setdefault(col.name, StringDictionary())
+
+    def encode(self, batch: ColumnarBatch) -> bytes:
+        """Render a batch as one self-contained block. String columns
+        missing a dictionary on the batch fall back to this encoder's
+        (they must be coded against it — same contract as sharing a
+        dictionary with BlockEncoder)."""
+        missing = [c.name for c in self.schema
+                   if c.is_string and c.name in batch.columns
+                   and c.name not in batch.dicts]
+        if missing:
+            batch = ColumnarBatch(
+                batch.columns,
+                {**{n: self.dicts[n] for n in missing}, **batch.dicts})
+        return _wire.encode_block(batch)
 
 
 def encode_tsv(batch: ColumnarBatch, schema=FLOW_SCHEMA) -> bytes:

@@ -523,12 +523,18 @@ class AdmissionController:
 
     # -- admission ---------------------------------------------------------
 
-    def admit(self, stream: str, nbytes: int) -> int:
+    def admit(self, stream: str, nbytes: int,
+              rows_hint: Optional[int] = None) -> int:
         """Gate one ingest request BEFORE decode. Returns the current
         brownout rung on success; raises AdmissionRejected (→ HTTP 429
         + Retry-After) when the request must not proceed. Charges the
         byte bucket (payload size is known here); rows are charged
-        after decode via `charge_rows`."""
+        after decode via `charge_rows` — UNLESS `rows_hint` gives the
+        exact row count up front (a TBLK block header, validated
+        against the payload size by `wire.peek_counts`), in which case
+        the row bucket and the stream rate estimate are charged here
+        and the caller skips `charge_rows` entirely: admission for a
+        self-contained block never needs the decode."""
         try:
             _fire_fault("admission.pressure", stream=stream)
         except FaultError as e:
@@ -560,6 +566,8 @@ class AdmissionController:
             if wait > 0.0:
                 self.reject("bytes", wait,
                              f"{nbytes} payload bytes over budget")
+        if rows_hint is not None:
+            self.charge_rows(stream, int(rows_hint))
         with self._lock:
             self.admitted += 1
         return level

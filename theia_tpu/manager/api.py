@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import io
 import json
+import math
 import tarfile
 import threading
 import time
@@ -100,6 +101,53 @@ _KIND_NAMES = {
     KIND_FPM: "FlowPatternMining",
     KIND_SPATIAL: "SpatialAnomalyDetection",
 }
+
+# Pre-serialized fragments for the two hot ingest ack shapes
+# ({"rows","alerts"[,"traceId"]} and the duplicate variant). The
+# ingest ingress answers every batch with one of these; building a
+# fresh dict walk + json.dumps per request showed up in profiles next
+# to the actual socket write.
+_ACK_ROWS = b'{"rows":'
+_ACK_ALERTS = b',"alerts":'
+_ACK_DUP = b',"duplicate":true'
+_ACK_TRACE = b',"traceId":"'
+
+
+def _fast_ack_bytes(doc: Dict[str, object]) -> Optional[bytes]:
+    """Serialize an ingest ack from cached fragments when it has one
+    of the two fixed hot shapes; None for anything else (forwardedRows,
+    degraded, parked...) — the caller falls back to json.dumps. The
+    output is byte-identical to json.dumps(doc, separators=(',',':'))
+    for the covered shapes."""
+    try:
+        rows = doc["rows"]
+        alerts = doc["alerts"]
+    except KeyError:
+        return None
+    dup = doc.get("duplicate")
+    trace = doc.get("traceId")
+    if len(doc) != 2 + (dup is not None) + (trace is not None):
+        return None
+    if type(rows) is not int or type(alerts) is not int \
+            or dup not in (None, True):
+        return None
+    parts = [_ACK_ROWS, str(rows).encode(), _ACK_ALERTS,
+             str(alerts).encode()]
+    if dup:
+        parts.append(_ACK_DUP)
+    if trace is not None:
+        if type(trace) is not str:
+            return None
+        try:
+            tid = trace.encode("ascii")
+        except UnicodeEncodeError:
+            return None
+        if b'"' in tid or b"\\" in tid:
+            return None
+        parts += [_ACK_TRACE, tid, b'"}']
+    else:
+        parts.append(b"}")
+    return b"".join(parts)
 
 
 def record_to_api(record: JobRecord, controller: JobController,
@@ -380,6 +428,20 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(raw)
 
+    def _send_ingest_ack(self, doc: Dict[str, object]) -> None:
+        """200 ack on the ingest hot path: cached-fragment
+        serialization for the two fixed ack shapes, json.dumps
+        fallback for the rest."""
+        raw = _fast_ack_bytes(doc)
+        if raw is None:
+            self._send_json(doc)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(raw)))
+        self.end_headers()
+        self.wfile.write(raw)
+
     def _send_error_json(self, code: int, message: str) -> None:
         # Error paths can fire BEFORE the request body was consumed
         # (auth, Content-Length validation, armed recv-side faults);
@@ -392,8 +454,8 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
     def _send_retry_after(self, e) -> None:
         """429 Too Many Requests + Retry-After (integer header per
         RFC 9110; the JSON body carries the precise float for clients
-        that can use it)."""
-        import math
+        that can use it). Runs only on the reject path — the admit
+        path never touches Retry-After math."""
         self.close_connection = True   # body may be unconsumed
         raw = json.dumps({
             "kind": "Status", "status": "Failure", "message": str(e),
@@ -1107,7 +1169,7 @@ class ManagerAPIHandler(BaseHTTPRequestHandler):
             payload = self._read_raw_body()
             if not payload:
                 raise ValueError("empty ingest payload")
-            self._send_json(self.ingest.ingest(
+            self._send_ingest_ack(self.ingest.ingest(
                 payload, stream=stream, seq=seq,
                 traceparent=self.headers.get("traceparent")))
             return

@@ -78,6 +78,7 @@ import numpy as np
 from ..analytics.heavy_hitters import HeavyHitterDetector
 from ..analytics.streaming import StreamingDetector
 from ..ingest.native import BLOCK_MAGIC, BLOCK_MAGIC_V1, TsvDecoder
+from ..store import wire as _wire
 from ..store.wal import RECORD_MAGIC
 from ..obs import metrics as _metrics
 from ..obs import trace as _trace
@@ -592,18 +593,42 @@ class IngestManager:
     def _ingest_admitted(self, payload: bytes, stream: str,
                          seq: Optional[int],
                          t_req: float) -> Dict[str, object]:
+        magic = payload[:4]
+        is_record = magic == RECORD_MAGIC
+        is_block = magic == _wire.BLOCK_MAGIC
+        rows_hint: Optional[int] = None
+        if is_block:
+            # TBLK: the block header names the exact row count, and
+            # `peek_counts` validates it against the payload size — so
+            # admission charges BOTH bytes and rows up front, without
+            # decoding a single column. A malformed header rejects
+            # here (→ 400) before it can touch any bucket.
+            try:
+                rows_hint, _ = _wire.peek_counts(payload, 4)
+            except _wire.WireCorruption:
+                _M_ERRORS.labels(stage="decode").inc()
+                raise
         level = LEVEL_OK
         if self.admission is not None:
             # raises AdmissionRejected → 429 + Retry-After (payload
-            # bytes are charged here; rows after decode)
-            level = self.admission.admit(stream, len(payload))
-        is_record = payload[:4] == RECORD_MAGIC
+            # bytes are charged here; rows after decode — except TBLK,
+            # whose header already charged them via rows_hint). The
+            # kwarg is passed only when a hint exists, so admit()
+            # stubs/wrappers with the pre-TBLK two-arg signature keep
+            # working for non-TBLK payloads.
+            if rows_hint is None:
+                level = self.admission.admit(stream, len(payload))
+            else:
+                level = self.admission.admit(stream, len(payload),
+                                             rows_hint=rows_hint)
         parked = None
-        if seq is not None and not is_record:
+        if seq is not None and not is_record and not is_block:
             with self._parked_lock:
                 pk = self._parked.get(stream)
                 if pk is not None and pk[0] == seq:
                     parked = pk[1]
+        wire_mv: Optional[memoryview] = None
+        pre_routed: Optional[List[Tuple[str, bytes, int]]] = None
         if parked is not None:
             # this block already decoded once (its failed attempt
             # advanced the stream's delta chain and charged the row
@@ -632,6 +657,34 @@ class IngestManager:
             except Exception as e:
                 _M_ERRORS.labels(stage="decode").inc()
                 raise ValueError(f"undecodable TREC payload: {e}")
+            _M_STAGE_DECODE.observe(time.perf_counter() - t_dec)
+        elif is_block:
+            # Self-contained TBLK block (the TFB3 producer format):
+            # stateless decode — no stream slot, no dictionary-delta
+            # chain, and no parked-batch bookkeeping (a retry simply
+            # decodes the identical bytes again). The received column
+            # section (`wire_mv`) rides on to the WAL so the journal
+            # writes the producer's bytes VERBATIM instead of
+            # re-encoding the adopted batch.
+            t_dec = time.perf_counter()
+            try:
+                wire_mv = memoryview(payload)[4:]
+                fwd = (self.router.split_wire(wire_mv)
+                       if self.router is not None else None)
+                if fwd is not None:
+                    # cross-node split on the ENCODED bytes: only
+                    # destinationIP was decoded to compute owners,
+                    # remote slices left as column-gathered TREC
+                    # payloads, and only the LOCAL slice is decoded
+                    # in full here
+                    local_wire, pre_routed = fwd
+                    wire_mv = memoryview(local_wire)
+                    batch, _end = _wire.decode_columns(wire_mv)
+                else:
+                    batch = _wire.decode_block(payload)
+            except ValueError:
+                _M_ERRORS.labels(stage="decode").inc()
+                raise
             _M_STAGE_DECODE.observe(time.perf_counter() - t_dec)
         else:
             st = self._stream(stream)
@@ -666,20 +719,23 @@ class IngestManager:
                     _M_ERRORS.labels(stage="decode").inc()
                     raise
                 _M_STAGE_DECODE.observe(time.perf_counter() - t_dec)
-        if parked is None and self.admission is not None:
+        if parked is None and not is_block \
+                and self.admission is not None:
             # post-decode row accounting: the row bucket may go into
             # debt, which rejects FUTURE requests until it refills
+            # (TBLK already charged its exact count from the header)
             self.admission.charge_rows(stream, len(batch))
         try:
             out = self._apply_decoded(batch, stream, seq, level,
-                                      t_req, is_record)
+                                      t_req, is_record, wire=wire_mv,
+                                      pre_routed=pre_routed)
         except Exception:
-            if seq is not None and not is_record:
+            if seq is not None and not is_record and not is_block:
                 # the stream's delta chain is already advanced past
                 # this block: hold its decoded form for the retry
                 self._park(stream, seq, batch)
             raise
-        if seq is not None and not is_record:
+        if seq is not None and not is_record and not is_block:
             self._unpark(stream, seq)
         return out
 
@@ -702,11 +758,21 @@ class IngestManager:
 
     def _apply_decoded(self, batch: ColumnarBatch, stream: str,
                        seq: Optional[int], level: int, t_req: float,
-                       is_record: bool) -> Dict[str, object]:
+                       is_record: bool,
+                       wire: Optional[memoryview] = None,
+                       pre_routed: Optional[List] = None
+                       ) -> Dict[str, object]:
         """Everything after a successful decode: routing, the
         pipelined insert ∥ score legs, the replication durability
         gate, dedup acks, and the response. Split out so a failure
-        anywhere in here can park the decoded batch for the retry."""
+        anywhere in here can park the decoded batch for the retry.
+
+        `wire` is the received TBLK column section covering exactly
+        `batch`'s rows (already gathered down to the local slice when
+        routed) — threaded to the store so the WAL journals it
+        verbatim. `pre_routed` carries `split_wire`'s already-gathered
+        remote slices; the TFB2/TSV path routes here instead, on the
+        decoded batch."""
         # -- cluster routing: keep owned rows, forward the rest --------
         # (before the pipelined legs: forwards overlap the local
         # insert/score work; owners admit/score/dedup their slices
@@ -716,7 +782,14 @@ class IngestManager:
         routed = None
         eff_stream = stream
         local_dup: Optional[int] = None
-        if self.router is not None and not is_record:
+        if pre_routed is not None:
+            routed = self.router.forward_all_wire(pre_routed, stream,
+                                                  seq)
+            if seq is not None:
+                eff_stream = self.router.sub_stream(stream)
+                local_dup = self.dedup.lookup(eff_stream, seq)
+        elif self.router is not None and not is_record \
+                and wire is None:
             local_batch, remote = self.router.split(batch)
             if remote:
                 routed = self.router.forward_all(remote, stream, seq)
@@ -748,7 +821,7 @@ class IngestManager:
         fut = None
         if not skip_local:
             fut = self._submit_insert(self._timed_insert, batch,
-                                      dedup_tag)
+                                      dedup_tag, wire)
         # Brownout: under pressure the scoring leg degrades first —
         # sampled at a declining fraction, then fully shed — while the
         # durable leg (WAL + store) keeps acknowledging rows.
@@ -847,12 +920,18 @@ class IngestManager:
         return out
 
     def _timed_insert(self, batch: ColumnarBatch,
-                      dedup: Optional[Tuple[str, int]] = None) -> int:
+                      dedup: Optional[Tuple[str, int]] = None,
+                      wire: Optional[memoryview] = None) -> int:
         t0 = time.perf_counter()
         try:
-            if dedup is None:
-                return self.db.insert_flows(batch)
-            return self.db.insert_flows(batch, dedup=dedup)
+            # kwargs are passed only when set, so minimal insert_flows
+            # signatures (test doubles, pre-wire stores) keep working
+            kwargs: Dict[str, object] = {}
+            if dedup is not None:
+                kwargs["dedup"] = dedup
+            if wire is not None:
+                kwargs["wire"] = wire
+            return self.db.insert_flows(batch, **kwargs)
         finally:
             _M_STAGE_STORE.observe(time.perf_counter() - t0)
 

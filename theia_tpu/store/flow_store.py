@@ -195,7 +195,9 @@ class Table:
         return ColumnarBatch(cols, self.dicts)
 
     def insert(self, batch: ColumnarBatch,
-               dedup: Optional[tuple] = None) -> Optional[ColumnarBatch]:
+               dedup: Optional[tuple] = None,
+               wire: Optional[memoryview] = None
+               ) -> Optional[ColumnarBatch]:
         """Insert a batch; returns the adopted (store-coded) batch, or
         None when empty, so callers can fan out the exact inserted block
         without re-reading the append log under concurrency. With a
@@ -207,10 +209,25 @@ class Table:
         (wal.pack_dedup_tag), making the acknowledgement itself
         crash-durable: recovery replays the rows AND restores the
         dedup-window entry from the same frame, so a retried batch is
-        idempotent across kill -9."""
+        idempotent across kill -9.
+
+        `wire` is a received TBLK column section already encoding
+        `batch`'s rows (store/wire.py): the WAL journals those bytes
+        VERBATIM instead of re-encoding the adopted batch — the
+        zero-copy half of the TBLK ingest path. It must cover exactly
+        the same rows; a row-count mismatch falls back to re-encoding
+        rather than journaling bytes that disagree with the ack."""
         if len(batch) == 0:
             return None
         adopted = self._adopt(batch)
+        if wire is not None:
+            from .wire import peek_counts
+            try:
+                w_rows, _ = peek_counts(wire)
+            except ValueError:
+                w_rows = -1
+            if w_rows != len(adopted):
+                wire = None
         hook = self._wal_hook
         if hook is None:
             self._append_adopted(adopted)
@@ -224,7 +241,7 @@ class Table:
                 total = (int(dedup[2]) if len(dedup) > 2
                          and dedup[2] is not None else len(batch))
                 name = pack_dedup_tag(self.name, stream, seq, total)
-            hook(name, adopted, self._append_adopted)
+            hook(name, adopted, self._append_adopted, wire=wire)
         return adopted
 
     def _append_adopted(self, adopted: ColumnarBatch) -> None:
@@ -860,22 +877,26 @@ class FlowDatabase:
 
     def insert_flows(self, batch: ColumnarBatch,
                      now: Optional[int] = None,
-                     dedup: Optional[tuple] = None) -> int:
+                     dedup: Optional[tuple] = None,
+                     wire: Optional[memoryview] = None) -> int:
         """Insert a flow batch; fan out to materialized views; evict
         TTL. `dedup=(stream, seq)` journals the producer's batch
-        identity with the rows (see Table.insert)."""
+        identity with the rows; `wire` (a received TBLK column
+        section for exactly these rows) makes the WAL journal the
+        producer's bytes verbatim (see Table.insert)."""
         latch = self._ingest_latch
         with (latch.read() if latch is not None
               else contextlib.nullcontext()):
-            return self._insert_flows_inner(batch, now, dedup)
+            return self._insert_flows_inner(batch, now, dedup, wire)
 
     def _insert_flows_inner(self, batch: ColumnarBatch,
                             now: Optional[int],
-                            dedup: Optional[tuple]) -> int:
+                            dedup: Optional[tuple],
+                            wire: Optional[memoryview] = None) -> int:
         # fires once per PHYSICAL store: once per replica in a
         # replicated fan-out, once per resync re-insert
         _fire_fault("store.insert", table="flows")
-        adopted = self.flows.insert(batch, dedup=dedup)
+        adopted = self.flows.insert(batch, dedup=dedup, wire=wire)
         if adopted is None:
             return 0
         # Views consume the adopted (store-coded) batch so their group

@@ -736,9 +736,13 @@ class PartTable(Table):
 
     def _append_adopted(self, adopted: ColumnarBatch,
                         seal: bool = True) -> None:
-        """Memtable append. `seal=False` is the snapshot-restore path:
-        recovery must not write fresh part files for rows the npz
-        already holds — the next live insert seals normally."""
+        """Memtable append. The batch's column arrays are adopted BY
+        REFERENCE — no copy between decode and memtable, which is the
+        last leg of the TBLK zero-copy ingest path (the decoded block's
+        arrays land here as-is; sealing re-encodes only when a part is
+        cut). `seal=False` is the snapshot-restore path: recovery must
+        not write fresh part files for rows the npz already holds — the
+        next live insert seals normally."""
         nbytes = sum(a.nbytes for a in adopted.columns.values())
         with self._lock:
             self._batches.append(adopted)

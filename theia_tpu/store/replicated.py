@@ -375,9 +375,14 @@ class ReplicatedFlowDatabase:
                 self._quarantine(i, e)
             return out
 
-    def insert_flows(self, batch, now=None, dedup=None) -> int:
+    def insert_flows(self, batch, now=None, dedup=None,
+                     wire=None) -> int:
+        # `wire` rides through to every replica: each journals the
+        # same received bytes verbatim (replicas are whole copies,
+        # unlike shard slices)
         n = self._fanout(
-            lambda r: r.insert_flows(batch, now=now, dedup=dedup),
+            lambda r: r.insert_flows(batch, now=now, dedup=dedup,
+                                     wire=wire),
             "insert_flows")
         nbytes = sum(np.asarray(a).nbytes
                      for a in batch.columns.values())

@@ -279,12 +279,16 @@ class ShardedFlowDatabase:
 
     def insert_flows(self, batch: ColumnarBatch,
                      now: Optional[int] = None,
-                     dedup: Optional[tuple] = None) -> int:
+                     dedup: Optional[tuple] = None,
+                     wire: Optional[memoryview] = None) -> int:
         """Route rows to shards (rand()); each shard maintains its own
         views/TTL on its slice, like a ClickHouse shard does. A
         `dedup` tag rides into every shard's WAL record (each slice
         journals under the same (stream, seq), so recovery re-sums
-        the full batch's ack)."""
+        the full batch's ack). A whole-batch `wire` section is
+        accepted but NOT forwarded: slices journal independently per
+        shard, so each shard re-encodes its own rows (the verbatim
+        fast path is the unsharded engine's)."""
         if len(batch) == 0:
             return 0
         assign = self.flows._assign(len(batch))

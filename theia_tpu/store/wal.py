@@ -100,6 +100,7 @@ from ..utils.faults import fire as _fire_fault
 from ..utils.logging import get_logger
 from ..analysis import lockdep as _lockdep
 from ..analysis.lockdep import named_lock
+from . import wire as _wire
 
 logger = get_logger("wal")
 
@@ -277,31 +278,24 @@ def default_sync_policy() -> SyncPolicy:
 
 
 # -- record codec ---------------------------------------------------------
+#
+# A WAL record body is a table-name header + a TBLK column section
+# (store/wire.py) — ONE codec shared with the producer wire format,
+# the part storage format, and the router's column-gather forwards.
+# `width_reduce` is re-exported here because the part builder and
+# historical callers import it from this module.
 
-def _byteview(arr: np.ndarray) -> memoryview:
-    """Flat byte view of a C-contiguous array — zero-copy: the append
-    path checksums and writes column buffers in place instead of
-    materializing a second copy of the whole batch."""
-    return memoryview(np.ascontiguousarray(arr)).cast("B")
+width_reduce = _wire.width_reduce
 
 
-def width_reduce(a: np.ndarray) -> Tuple[np.ndarray, int]:
-    """(stored, base): the narrowest unsigned representation of
-    (a - min). Ports and flags are int64 in the schema but fit a byte,
-    and per-batch timestamps cluster within seconds of each other —
-    the ~3x byte cut behind both the WAL record format and the part
-    storage format (store/parts.py). Returns (a, 0) unchanged when no
-    narrower type holds the span."""
-    if a.dtype.kind in "iu" and a.itemsize > 1 and len(a):
-        mn, mx = int(a.min()), int(a.max())
-        span = mx - mn
-        for cand in ("<u1", "<u2", "<u4"):
-            cdt = np.dtype(cand)
-            if cdt.itemsize >= a.itemsize:
-                break
-            if span <= int(np.iinfo(cdt).max):
-                return (a - mn).astype(cand), mn
-    return a, 0
+def pack_table_header(table: str) -> bytes:
+    """The record-body prefix for `table`: u16 length + utf-8 name
+    (or a dedup TAG — see `pack_dedup_tag`). A received TBLK column
+    section becomes a journalable record body by prepending exactly
+    this, which is what lets the ingest path journal producer bytes
+    verbatim."""
+    tname = table.encode("utf-8")
+    return struct.pack("<H", len(tname)) + tname
 
 
 def encode_record_parts(table: str, batch: ColumnarBatch
@@ -311,54 +305,12 @@ def encode_record_parts(table: str, batch: ColumnarBatch
     the appender checksums and writes them without ever concatenating.
 
     String columns (those with a dictionary on the batch) ship their
-    unique strings + int32 local codes, so replay never depends on
-    dictionary state; numeric columns ship raw little-endian bytes.
-    The LSN is NOT part of the body — it is assigned at append time
-    under the I/O lock and prepended there."""
-    tname = table.encode("utf-8")
-    parts: List = [
-        struct.pack("<H", len(tname)) + tname
-        + struct.pack("<IH", len(batch), len(batch.columns)),
-    ]
-    for name, arr in batch.columns.items():
-        bname = name.encode("utf-8")
-        d = batch.dicts.get(name)
-        if d is not None:
-            codes = np.ascontiguousarray(arr)
-            # O(n + dict) unique via occupancy mask (codes are dense
-            # dictionary indices) — ~10x cheaper than sort-based
-            # np.unique on large batches
-            mask = np.zeros(len(d), bool)
-            mask[codes] = True
-            uniq = np.flatnonzero(mask)
-            code_dt = ("<u1" if len(uniq) <= 0xFF
-                       else "<u2" if len(uniq) <= 0xFFFF else "<i4")
-            remap = (np.cumsum(mask, dtype=np.int32) - 1).astype(
-                code_dt)
-            local = np.ascontiguousarray(remap[codes])
-            encoded = [str(s).encode("utf-8") for s in d.decode(uniq)]
-            lens = np.fromiter(map(len, encoded), "<i4",
-                               count=len(encoded))
-            blob = b"".join(encoded)
-            parts.append(struct.pack("<H", len(bname)) + bname
-                         + struct.pack("<BIIB", 1, len(uniq),
-                                       len(blob), local.itemsize))
-            parts.append(_byteview(lens))
-            parts.append(blob)
-            parts.append(_byteview(local))
-        else:
-            a = np.ascontiguousarray(arr)
-            if a.dtype.byteorder == ">":
-                a = a.astype(a.dtype.newbyteorder("<"))
-            dt = a.dtype.str.encode("ascii")
-            stored, base = width_reduce(a)
-            sdt = stored.dtype.str.encode("ascii")
-            parts.append(struct.pack("<H", len(bname)) + bname
-                         + struct.pack("<BH", 0, len(dt)) + dt
-                         + struct.pack("<H", len(sdt)) + sdt
-                         + struct.pack("<qI", base, stored.nbytes))
-            parts.append(_byteview(stored))
-    return parts
+    unique strings + local codes, so replay never depends on
+    dictionary state; numeric columns ship width-reduced little-endian
+    bytes. The LSN is NOT part of the body — it is assigned at append
+    time under the I/O lock and prepended there."""
+    return [pack_table_header(table),
+            *_wire.encode_columns_parts(batch)]
 
 
 def encode_record_body(table: str, batch: ColumnarBatch) -> bytes:
@@ -393,83 +345,12 @@ def _decode_record_body(body: bytes,
                         ) -> Tuple[str, ColumnarBatch]:
     mv = memoryview(body)
     (tlen,) = struct.unpack_from("<H", mv, 0)
-    off = 2
-    table = bytes(mv[off:off + tlen]).decode("utf-8")
-    off += tlen
-    n_rows, n_cols = struct.unpack_from("<IH", mv, off)
-    off += 6
-    cols: Dict[str, np.ndarray] = {}
-    dicts: Dict[str, StringDictionary] = {}
-    for _ in range(n_cols):
-        (nlen,) = struct.unpack_from("<H", mv, off)
-        off += 2
-        name = bytes(mv[off:off + nlen]).decode("utf-8")
-        off += nlen
-        (kind,) = struct.unpack_from("<B", mv, off)
-        off += 1
-        wanted = columns is None or name in columns
-        if kind == 1:
-            n_uniq, blob_len, code_size = struct.unpack_from(
-                "<IIB", mv, off)
-            off += 9
-            if not wanted:
-                if code_size not in (1, 2, 4):
-                    raise WalCorruption(
-                        f"bad string code itemsize {code_size}")
-                off += 4 * n_uniq + blob_len + code_size * n_rows
-                continue
-            lens = np.frombuffer(mv, "<i4", count=n_uniq, offset=off)
-            off += 4 * n_uniq
-            blob = bytes(mv[off:off + blob_len])
-            off += blob_len
-            d = StringDictionary()
-            mapping = np.empty(max(n_uniq, 1), np.int32)
-            pos = 0
-            for i in range(n_uniq):
-                end = pos + int(lens[i])
-                mapping[i] = d.encode_one(blob[pos:end].decode("utf-8"))
-                pos = end
-            if pos != blob_len:
-                raise WalCorruption("string blob length mismatch")
-            code_dt = {1: "<u1", 2: "<u2", 4: "<i4"}.get(code_size)
-            if code_dt is None:
-                raise WalCorruption(
-                    f"bad string code itemsize {code_size}")
-            local = np.frombuffer(mv, code_dt, count=n_rows,
-                                  offset=off).astype(np.int64)
-            off += code_size * n_rows
-            cols[name] = (mapping[:n_uniq][local] if n_uniq
-                          else np.zeros(n_rows, np.int32))
-            dicts[name] = d
-        elif kind == 0:
-            (dlen,) = struct.unpack_from("<H", mv, off)
-            off += 2
-            dtype = np.dtype(bytes(mv[off:off + dlen]).decode("ascii"))
-            off += dlen
-            (slen,) = struct.unpack_from("<H", mv, off)
-            off += 2
-            stored_dt = np.dtype(
-                bytes(mv[off:off + slen]).decode("ascii"))
-            off += slen
-            base, rlen = struct.unpack_from("<qI", mv, off)
-            off += 12
-            if not wanted:
-                off += rlen
-                continue
-            arr = np.frombuffer(mv, stored_dt, count=n_rows,
-                                offset=off)
-            arr = arr.astype(dtype) if stored_dt != dtype \
-                else arr.copy()
-            if base:
-                arr += dtype.type(base)
-            off += rlen
-            cols[name] = arr
-        else:
-            raise WalCorruption(f"unknown column kind {kind}")
+    table = bytes(mv[2:2 + tlen]).decode("utf-8")
+    batch, off = _wire.decode_columns(mv, 2 + tlen, columns)
     if off != len(body):
         raise WalCorruption(
             f"record has {len(body) - off} trailing bytes")
-    return table, ColumnarBatch(cols, dicts)
+    return table, batch
 
 
 # -- snapshot/append coordination ----------------------------------------
@@ -699,27 +580,42 @@ class WriteAheadLog:
         return self._latch.write()
 
     def logged_apply(self, table: str, adopted: ColumnarBatch,
-                     apply: Callable[[ColumnarBatch], None]) -> None:
+                     apply: Callable[[ColumnarBatch], None],
+                     wire: Optional[memoryview] = None) -> None:
         """The insert-path hook: append the record, then apply it to
         memory, atomically with respect to `quiesce()`; then run the
         sync policy. An append failure propagates BEFORE the memory
         apply — the row is neither visible nor acknowledged, so a
         broken log fails inserts instead of silently un-journaling
-        them."""
+        them. `wire` (a received TBLK column section covering exactly
+        these rows) is journaled verbatim instead of re-encoding the
+        adopted batch."""
         with self._latch.read():
-            self.append(table, adopted)
+            self.append(table, adopted, wire=wire)
             apply(adopted)
         self._policy_sync()
 
-    def append(self, table: str, batch: ColumnarBatch) -> int:
+    def append(self, table: str, batch: ColumnarBatch,
+               wire: Optional[memoryview] = None) -> int:
         """Append one record; returns its LSN. The frame is written
         with a single buffered write + flush, so a crash tears at most
-        the tail of this record (which recovery truncates)."""
+        the tail of this record (which recovery truncates).
+
+        When `wire` is given it must be the TBLK column section (no
+        magic) already encoding `batch`'s rows: the record body
+        becomes table header + those bytes VERBATIM — the zero-copy
+        half of the TBLK ingest path, where producer bytes are
+        checksummed and written without a decode→re-encode round
+        trip. Replay decodes the self-contained section exactly like
+        a locally-encoded record."""
         _fire_fault("wal.append", table=table, dir=self.dir)
         # Encode + bulk checksum OUTSIDE the I/O lock: concurrent
         # inserts overlap the expensive part; only LSN assignment and
         # the writes serialize.
-        parts = encode_record_parts(table, batch)
+        if wire is not None:
+            parts: List = [pack_table_header(table), wire]
+        else:
+            parts = encode_record_parts(table, batch)
         body_len = sum(len(p) for p in parts)
         body_crc = 0
         for p in parts:

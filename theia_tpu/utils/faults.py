@@ -106,6 +106,8 @@ KNOWN_SITES = (
     "net.recv",
     "peer.partition",
     "admission.pressure",
+    "wire.decode",
+    "wire.gather",
 )
 
 _M_FIRINGS = _metrics.counter(
