@@ -838,6 +838,113 @@ def run_benchmarks() -> dict:
         print(f"fused bench skipped: {e}", file=sys.stderr)
         traceback.print_exc(file=sys.stderr)
 
+    # Working-set state-tier legs (ingest/state_tier.py): ≥1M distinct
+    # 5-tuples (FAST: 100k) with Zipf re-arrival driven through a
+    # deliberately small hot-slot budget. The parity gate runs FIRST
+    # and is the tier's whole contract: ZERO
+    # theia_detector_series_dropped_total, hot occupancy never above
+    # the budget, zero transient overflow, and an alert stream
+    # bit-identical to an unbounded-slots oracle over the same input —
+    # only then is the tiered detector's throughput timed.
+    working_set_parity_ok = None
+    working_set_rate = 0.0
+    working_set_times: list = []
+    try:
+        import gc as _wgc
+
+        from theia_tpu.analytics.streaming import (
+            StreamingDetector as _WDet)
+        from theia_tpu.ingest.state_tier import (
+            TierConfig as _WCfg, WorkingSetTier as _WTier)
+        from theia_tpu.schema import ColumnarBatch as _WBatch
+
+        fast_ws = os.environ.get("THEIA_BENCH_FAST") == "1"
+        n_keys = 100_000 if fast_ws else 1_000_000
+        budget = 8_192 if fast_ws else 32_768
+        batch_rows = 4_096 if fast_ws else 16_384
+        rng_ws = np.random.default_rng(7)
+        # every key appears at least once (a permutation), then a
+        # Zipf-distributed re-arrival tail exercises promote-on-
+        # re-arrival against the long tail
+        idx_stream = np.concatenate([
+            rng_ws.permutation(n_keys),
+            rng_ws.zipf(1.3, size=n_keys // 2).astype(np.int64)
+            % n_keys])
+        vals_stream = rng_ws.random(len(idx_stream)) * 1e3
+
+        def _ws_batch(lo, hi):
+            ix = idx_stream[lo:hi]
+            n = len(ix)
+            return _WBatch({
+                "sourceIP": ix.astype(np.int64),
+                "sourceTransportPort": np.full(n, 1234, np.int64),
+                "destinationIP": (ix * 7).astype(np.int64),
+                "destinationTransportPort": np.full(n, 80, np.int64),
+                "protocolIdentifier": np.full(n, 6, np.int64),
+                "flowStartSeconds": np.full(n, 1, np.int64),
+                "throughput": vals_stream[lo:hi],
+                "flowEndSeconds": np.full(n, 100, np.int64),
+            }, {})
+
+        def _ws_strip(alerts):
+            return sorted(
+                tuple(sorted((k, v) for k, v in a.items()
+                             if k not in ("latency_s", "slot", "row")))
+                for a in alerts)
+
+        def _ws_run(det, tier=None):
+            drained = []
+            for lo in range(0, len(idx_stream), batch_rows):
+                drained.append(_ws_strip(
+                    det.ingest(_ws_batch(lo, lo + batch_rows))))
+                if tier is not None and tier.n_hot > budget:
+                    raise AssertionError(
+                        f"hot occupancy {tier.n_hot} > budget {budget}")
+            return drained
+
+        # parity gate — before any timed window
+        tier_g = _WTier(_WCfg(hot_watermark=0.9, evict_to=0.7,
+                              age_out_seconds=0.0))
+        det_t = _WDet(capacity=budget, tier=tier_g)
+        det_o = _WDet(capacity=n_keys + 64)
+        a_t = _ws_run(det_t, tier_g)
+        a_o = _ws_run(det_o)
+        working_set_parity_ok = (
+            a_t == a_o and det_t.dropped_series == 0
+            and tier_g.overflow == 0 and tier_g.n_hot <= budget)
+        print(f"working-set parity ({n_keys:,} keys, budget "
+              f"{budget:,}): "
+              + ("ok" if working_set_parity_ok else "MISMATCH")
+              + f" [evictions {tier_g.evictions:,}, promotions "
+              f"{tier_g.promotions_warm + tier_g.promotions_cold:,}]",
+              file=sys.stderr)
+        del det_t, det_o, tier_g, a_t, a_o
+        _wgc.collect()
+
+        if working_set_parity_ok:
+            for _ in range(1 if fast_ws else 2):  # best-of-2 vs steal
+                det_w = _WDet(capacity=budget, tier=_WTier(
+                    _WCfg(hot_watermark=0.9, evict_to=0.7,
+                          age_out_seconds=0.0)))
+                det_w.ingest(_ws_batch(0, batch_rows))  # warm jit
+                t0w = time.perf_counter()
+                for lo in range(batch_rows, len(idx_stream),
+                                batch_rows):
+                    det_w.ingest(_ws_batch(lo, lo + batch_rows))
+                working_set_times.append(time.perf_counter() - t0w)
+                del det_w
+                _wgc.collect()
+            rows_w = len(idx_stream) - batch_rows
+            working_set_rate = rows_w / min(working_set_times)
+            print(f"working-set detector leg: "
+                  f"{working_set_rate:,.0f} rows/s "
+                  f"({n_keys:,} distinct keys through "
+                  f"{budget:,} hot slots)", file=sys.stderr)
+    except Exception as e:
+        import traceback
+        print(f"working-set bench skipped: {e}", file=sys.stderr)
+        traceback.print_exc(file=sys.stderr)
+
     # TBLK zero-copy wire format vs TFB2 on the ACKED e2e path at
     # interval:1 durability (the PR-16 tentpole's design point: the
     # ack is WAL-journaled, and the TBLK body journals VERBATIM).
@@ -2682,6 +2789,15 @@ def run_benchmarks() -> dict:
         result.update(overload)
     if cluster_bench:
         result.update(cluster_bench)
+    if working_set_parity_ok is not None:
+        result["working_set_parity_ok"] = working_set_parity_ok
+    if working_set_rate:
+        result["detector_working_set_rows_per_sec"] = round(
+            working_set_rate)
+    if working_set_times:
+        leg_stats["detector_working_set"] = _leg_stats(
+            working_set_times)
+        result["leg_stats"] = leg_stats
     if fused_parity_ok is not None:
         result["fused_parity_ok"] = fused_parity_ok
     if fused_det_rate:

@@ -1,0 +1,457 @@
+"""Flow-state working-set tier (ingest/state_tier.py): exact
+promote-on-re-arrival parity, LRU eviction bounds, batch-vectorized
+cost, fault drills, and kill -9 mid-spill recovery."""
+
+import sys
+
+import numpy as np
+import pytest
+
+from theia_tpu.analytics.streaming import StreamingDetector
+from theia_tpu.ingest.state_tier import (
+    DETSTATE_TABLE,
+    SpillStore,
+    TierConfig,
+    WorkingSetTier,
+    key_hash,
+)
+from theia_tpu.schema import ColumnarBatch, StringDictionary
+from theia_tpu.store.flow_store import FlowDatabase
+from theia_tpu.utils import faults
+from theia_tpu.utils.faults import FaultError
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+def _key(i):
+    return (i, 1234, i * 7, 80, 6, 1)
+
+
+def _batch(keys, vals, t0=100):
+    n = len(keys)
+    cols = {
+        "sourceIP": np.array([k[0] for k in keys], np.int64),
+        "sourceTransportPort": np.array([k[1] for k in keys], np.int64),
+        "destinationIP": np.array([k[2] for k in keys], np.int64),
+        "destinationTransportPort": np.array(
+            [k[3] for k in keys], np.int64),
+        "protocolIdentifier": np.array([k[4] for k in keys], np.int64),
+        "flowStartSeconds": np.array([k[5] for k in keys], np.int64),
+        "throughput": np.asarray(vals, np.float64),
+        "flowEndSeconds": np.full(n, t0, np.int64),
+    }
+    return ColumnarBatch(cols, {})
+
+
+def _strip(alerts):
+    """Alert content only: slot ids are allocation artifacts (a tiered
+    detector reuses slots; the oracle bump-allocates) and latency is a
+    measurement."""
+    return sorted(
+        tuple(sorted((k, v) for k, v in a.items()
+                     if k not in ("latency_s", "slot", "row")))
+        for a in alerts)
+
+
+def _drive(det, oracle, rng, n_keys, n_steps, per_batch, tier=None,
+           cap=None, clock=None):
+    """Feed identical random batches to both detectors, asserting
+    alert parity, zero drops, zero overflow, bounded occupancy."""
+    for step in range(n_steps):
+        if clock is not None:
+            clock[0] += 1.0
+        idx = rng.integers(0, n_keys, size=per_batch)
+        vals = rng.random(per_batch) * 100
+        b = _batch([_key(i) for i in idx], vals)
+        assert _strip(det.ingest(b)) == _strip(oracle.ingest(b)), \
+            f"alert divergence at step {step}"
+        assert det.dropped_series == 0
+        if tier is not None:
+            assert tier.overflow == 0
+            assert tier.n_hot <= (cap or det.capacity)
+
+
+def test_evict_promote_alert_parity():
+    """The tier's whole contract: a small-budget tiered detector's
+    alert stream is bit-identical to an unbounded oracle while state
+    spills and promotes constantly, with zero dropped series and hot
+    occupancy never above the budget."""
+    tier = WorkingSetTier(TierConfig(hot_watermark=0.9, evict_to=0.5,
+                                     age_out_seconds=0.0))
+    det = StreamingDetector(capacity=16, tier=tier)
+    oracle = StreamingDetector(capacity=10_000)
+    _drive(det, oracle, np.random.default_rng(0), n_keys=64,
+           n_steps=150, per_batch=10, tier=tier, cap=16)
+    # the workload must actually have exercised the tier
+    assert tier.evictions > 100
+    assert tier.promotions_warm > 100
+    # scrape-time occupancy gauges sum over live tiers through the
+    # DEFAULT (exposed) child — labels() on an unlabeled gauge mints
+    # an orphan the registry never renders
+    from theia_tpu.ingest import state_tier as _st
+    assert _st._G_HOT.value() >= tier.n_hot > 0
+    assert _st._G_SPILLED.value() >= tier.spilled_count > 0
+
+
+def test_age_out_to_cold_store_parity():
+    """Warm blocks idle past the age-out threshold fall back to the
+    durable store; re-arrival promotes from the cold tier with the
+    exact pre-spill state (still alert-parity with the oracle)."""
+    db = FlowDatabase()
+    store = SpillStore(db.result_tables[DETSTATE_TABLE])
+    clock = [0.0]
+    tier = WorkingSetTier(TierConfig(0.9, 0.5, age_out_seconds=10.0),
+                          store=store, clock=lambda: clock[0])
+    det = StreamingDetector(capacity=8, tier=tier)
+    oracle = StreamingDetector(capacity=10_000)
+    _drive(det, oracle, np.random.default_rng(1), n_keys=32,
+           n_steps=120, per_batch=5, tier=tier, cap=8, clock=clock)
+    assert tier.age_outs > 0, "age-out path never exercised"
+    assert tier.promotions_cold > 0, "cold promotion never exercised"
+    # prune keeps exactly the latest spill per key
+    assert len(db.result_tables[DETSTATE_TABLE]) > 0
+    store.prune()
+    data = db.result_tables[DETSTATE_TABLE].select(
+        columns=["keyHash"])
+    kh = np.asarray(data["keyHash"])
+    assert len(np.unique(kh)) == len(kh)
+
+
+def test_promoted_state_bit_identical():
+    """Spill → promote round-trips the float32 state exactly: after a
+    key is evicted and re-arrives, its slot state equals a
+    never-evicted copy bit for bit (the f64 wire columns hold f32
+    values exactly)."""
+    tier = WorkingSetTier(TierConfig(0.9, 0.25, 0.0))
+    det = StreamingDetector(capacity=4, tier=tier)
+    ref = StreamingDetector(capacity=64)
+    rng = np.random.default_rng(2)
+    seq = [0, 1, 2, 3, 4, 5, 0, 1, 6, 7, 0, 2, 4, 0]
+    for i in seq:
+        v = [float(rng.random() * 50)]
+        det.ingest(_batch([_key(i)], v))
+        ref.ingest(_batch([_key(i)], v))
+    assert tier.evictions > 0 and tier.promotions_warm > 0
+    # compare key 0's state wherever each detector holds it
+    kb = np.array(_key(0), np.int64).tobytes()
+    s_t, s_r = det._slots[kb], ref._slots[kb]
+    for a, b in zip(det.state, ref.state):
+        av, bv = np.asarray(a)[s_t], np.asarray(b)[s_r]
+        assert av.tobytes() == bv.tobytes()
+
+
+def test_no_per_row_python_in_microbatch_step():
+    """Eviction/promotion cost is batch-vectorized: the Python call
+    count of a tiered micro-batch step scales with DISTINCT keys, not
+    rows — a 10x-rows batch over the same key set must cost the same
+    Python calls (ISSUE 18 acceptance)."""
+    def count_calls(det, batch):
+        n = [0]
+
+        def prof(frame, event, arg):
+            if event == "call":
+                n[0] += 1
+
+        sys.setprofile(prof)
+        try:
+            det.ingest(batch)
+        finally:
+            sys.setprofile(None)
+        return n[0]
+
+    rng = np.random.default_rng(3)
+    n_keys = 64
+
+    def mk(reps):
+        idx = np.tile(np.arange(n_keys), reps)
+        return _batch([_key(i) for i in idx],
+                      rng.random(len(idx)) * 100)
+
+    def tiered(reps):
+        t = WorkingSetTier(TierConfig(0.9, 0.5, 0.0))
+        d = StreamingDetector(capacity=32, tier=t)
+        # warm at the measured tile shape: jit tracing is per-shape
+        # one-time Python, not per-row work
+        d.ingest(mk(reps))
+        d.ingest(mk(reps))
+        return d
+
+    c1 = count_calls(tiered(1), mk(1))
+    c10 = count_calls(tiered(10), mk(10))
+    # 10x rows, same distinct keys: call counts must be ~equal (jit
+    # cache variance allowed), nowhere near 10x
+    assert c10 < 2 * c1 + 200, (c1, c10)
+
+
+def test_kill9_mid_spill_recovery(tmp_path):
+    """kill -9 between spills: the detstate rows already WAL-journaled
+    survive, recovery rebuilds the cold index through the standard
+    replay path, and a re-arriving flow scores with its pre-crash
+    history — alert parity against an uncrashed oracle fed the same
+    total point stream."""
+    wal_dir = str(tmp_path / "wal")
+    db = FlowDatabase()
+    db.attach_wal(wal_dir, sync="always")
+    store = SpillStore(db.result_tables[DETSTATE_TABLE])
+    tier = WorkingSetTier(TierConfig(0.9, 0.25, 0.0), store=store)
+    det = StreamingDetector(capacity=4, tier=tier)
+    oracle = StreamingDetector(capacity=10_000)
+
+    rng = np.random.default_rng(4)
+    pre = [(i, float(rng.random() * 50)) for i in
+           [0, 1, 2, 3, 4, 5, 6, 7, 0, 8, 9, 10, 11]]
+    for i, v in pre:
+        det.ingest(_batch([_key(i)], [v]))
+        oracle.ingest(_batch([_key(i)], [v]))
+    assert tier.evictions > 0
+    spilled_pre = {
+        int(h) for blk in tier.blocks.values() for h in blk.hashes}
+    # kill -9: abandon db/tier without close; only the WAL survives
+    del det, tier, store
+
+    db2 = FlowDatabase()
+    db2.attach_wal(wal_dir, sync="always")
+    table2 = db2.result_tables[DETSTATE_TABLE]
+    assert len(table2) > 0, "spilled state did not survive the crash"
+    cold = SpillStore.recover_cold_indexes(table2, 1, lambda d: 0)[0]
+    assert spilled_pre <= set(cold), \
+        "recovery lost spilled series"
+
+    tier2 = WorkingSetTier(TierConfig(0.9, 0.25, 0.0),
+                           store=SpillStore(table2), cold_index=cold)
+    det2 = StreamingDetector(capacity=4, tier=tier2)
+    # keys 1 and 2 were spilled pre-crash and now re-arrive: their
+    # pre-crash history must drive the same alerts the oracle's does
+    post = [(1, 45.0), (2, 48.0), (1, 2.0), (2, 1.0), (1, 44.0)]
+    for i, v in post:
+        a1 = _strip(det2.ingest(_batch([_key(i)], [v])))
+        a2 = _strip(oracle.ingest(_batch([_key(i)], [v])))
+        assert a1 == a2
+    assert tier2.promotions_cold > 0, \
+        "re-arrival did not promote from the recovered cold tier"
+    db2.close_wal()
+
+
+def test_fault_spill_error_leaves_state_intact_and_retries():
+    """state.spill fires BEFORE any mutation: an injected error fails
+    the batch with hot state fully intact, and the retry (disarmed)
+    spills and scores identically to a never-faulted oracle."""
+    tier = WorkingSetTier(TierConfig(0.9, 0.5, 0.0))
+    det = StreamingDetector(capacity=8, tier=tier)
+    oracle = StreamingDetector(capacity=10_000)
+    rng = np.random.default_rng(5)
+    fill = [_key(i) for i in range(7)]
+    b0 = _batch(fill, rng.random(7) * 100)
+    assert _strip(det.ingest(b0)) == _strip(oracle.ingest(b0))
+    snap_slots = dict(det._slots)
+    snap_hot = tier.n_hot
+
+    faults.arm("state.spill:error")
+    b1 = _batch([_key(i) for i in range(7, 14)], rng.random(7) * 100)
+    with pytest.raises(FaultError):
+        det.ingest(b1)
+    assert det._slots == snap_slots and tier.n_hot == snap_hot
+    assert tier.evictions == 0
+
+    faults.disarm()
+    assert _strip(det.ingest(b1)) == _strip(oracle.ingest(b1))
+    assert tier.evictions > 0 and det.dropped_series == 0
+
+
+def test_fault_promote_error_and_age_out_deferred():
+    """state.promote error-mode fails the batch before warm state is
+    consumed (retry-safe); state.age_out error-mode defers the
+    maintenance round instead of failing the batch."""
+    clock = [0.0]
+    tier = WorkingSetTier(TierConfig(0.9, 0.25, age_out_seconds=50.0),
+                          clock=lambda: clock[0])
+    det = StreamingDetector(capacity=4, tier=tier)
+    rng = np.random.default_rng(6)
+    for i in range(8):   # force evictions
+        det.ingest(_batch([_key(i)], [float(rng.random())]))
+    assert tier.evictions > 0
+    warm_before = dict(tier.warm)
+
+    faults.arm("state.promote:error")
+    victim = next(iter(warm_before))
+    k6 = tuple(int(v) for v in np.frombuffer(victim, np.int64))
+    with pytest.raises(FaultError):
+        det.ingest(_batch([k6], [1.0]))
+    assert tier.warm == warm_before   # untouched → retry-safe
+    faults.disarm()
+    det.ingest(_batch([k6], [1.0]))
+    assert victim not in tier.warm
+
+    # age-out: armed error defers (no raise), disarm lets it run
+    faults.arm("state.age_out:error")
+    clock[0] += 100.0
+    det.ingest(_batch([_key(50)], [1.0]))
+    assert tier.age_outs == 0
+    faults.disarm()
+    det.ingest(_batch([_key(51)], [1.0]))
+    assert tier.age_outs > 0
+
+
+def test_detector_engine_auto(monkeypatch):
+    """`auto` is a valid THEIA_DETECTOR_ENGINE value that resolves to
+    a concrete engine per backend — sharded on CPU-only hosts (the
+    PR-16 crossover), fused on accelerators."""
+    from theia_tpu.manager.ingest import (
+        DETECTOR_ENGINES,
+        IngestManager,
+        resolve_auto_engine,
+    )
+    assert "auto" in DETECTOR_ENGINES
+    import jax
+    expected = ("fused" if jax.default_backend() in ("tpu", "gpu")
+                else "sharded")
+    assert resolve_auto_engine() == expected
+    im = IngestManager(FlowDatabase(), n_shards=1, engine="auto")
+    try:
+        assert im.engine_requested == "auto"
+        assert im.engine_name == expected
+        assert im.shard_liveness()["engine"]["requested"] == "auto"
+    finally:
+        im.close()
+    with pytest.raises(ValueError):
+        IngestManager(FlowDatabase(), n_shards=1, engine="bogus")
+
+
+def _flow_batch(n, n_flows, seed=0, offset=0):
+    """`offset` rotates the flow population so successive batches'
+    working sets overlap partially — distinct-per-batch stays under
+    the slot budget (no transient overflow) while the union exceeds
+    it (evictions + promotions actually run)."""
+    rng = np.random.default_rng(seed)
+    dicts = {"sourceIP": StringDictionary(),
+             "destinationIP": StringDictionary()}
+    src = np.array(
+        [dicts["sourceIP"].encode_one(f"10.0.{offset + i % n_flows}.1")
+         for i in range(n)], np.int32)
+    dst = np.array(
+        [dicts["destinationIP"].encode_one(
+            f"10.1.{offset + i % n_flows}.1")
+         for i in range(n)], np.int32)
+    return ColumnarBatch({
+        "sourceIP": src, "destinationIP": dst,
+        "sourceTransportPort": np.full(n, 1234, np.int32),
+        "destinationTransportPort": np.full(n, 80, np.int32),
+        "protocolIdentifier": np.full(n, 6, np.int32),
+        "flowStartSeconds": np.full(n, 1, np.int64),
+        "flowEndSeconds": np.full(n, 100, np.int64),
+        "throughput": rng.integers(1, 1000, n).astype(np.int64),
+        "octetDeltaCount": rng.integers(1, 1000, n).astype(np.int64),
+        "packetDeltaCount": rng.integers(1, 100, n).astype(np.int64),
+        "reverseOctetDeltaCount": np.zeros(n, np.int64),
+    }, dicts)
+
+
+def test_manager_tier_end_to_end(monkeypatch):
+    """THEIA_STATE_TIER=1 wires per-shard tiers into a manager: scoring
+    spills through the detstate table with string-resolved identity, a
+    restarted manager over the same db recovers the cold index, and
+    the health/admission surfaces expose the tier."""
+    monkeypatch.setenv("THEIA_STATE_TIER", "1")
+    db = FlowDatabase()
+    im = IngestManagerFactory(db, n_shards=2, streaming_capacity=16)
+    try:
+        assert len(im._tiers) == 2
+        for k in range(8):
+            im.score_batch(
+                _flow_batch(120, n_flows=20, offset=10 * (k % 4)))
+        stats = im.detector_stats()
+        assert "stateTier" in stats
+        assert sum(t["evictions"] for t in stats["stateTier"]) > 0
+        for s in im.shards:
+            assert s.streaming.dropped_series == 0
+        live = im.shard_liveness()
+        assert "stateTier" in live["perShard"][0]
+        assert im.admission is not None
+        assert "stateSpill" in im.admission._signals
+        # durable rows carry decoded string identity
+        table = db.result_tables[DETSTATE_TABLE]
+        assert len(table) > 0
+        row0 = table.select(columns=["destinationIP"])
+        d = row0.dicts["destinationIP"]
+        assert d.decode_one(int(row0["destinationIP"][0])).startswith(
+            "10.1.")
+    finally:
+        im.close()
+
+    # restart over the same (surviving) db: cold index recovers and
+    # shard assignment re-derives from strings
+    im2 = IngestManagerFactory(db, n_shards=2, streaming_capacity=16)
+    try:
+        assert sum(len(t.cold) for t in im2._tiers) > 0
+        for k in range(4):
+            im2.score_batch(
+                _flow_batch(120, n_flows=20, offset=10 * (k % 4)))
+        assert sum(t.promotions_cold for t in im2._tiers) > 0
+    finally:
+        im2.close()
+
+
+def IngestManagerFactory(*a, **k):
+    from theia_tpu.manager.ingest import IngestManager
+    return IngestManager(*a, **k)
+
+
+def test_manager_tier_off_by_default(monkeypatch):
+    """Without THEIA_STATE_TIER the manager keeps the legacy
+    drop-at-capacity behavior (the sizing-experiment contract the seed
+    tests assert)."""
+    monkeypatch.delenv("THEIA_STATE_TIER", raising=False)
+    im = IngestManagerFactory(FlowDatabase(), n_shards=1,
+                              streaming_capacity=4)
+    try:
+        assert im._tiers == []
+        assert im.shards[0].streaming.tier is None
+    finally:
+        im.close()
+
+
+def test_fused_engine_with_tier_parity(monkeypatch):
+    """The tier rides the fused engine's micro-batch step too (assign
+    runs inside build_plan, before the shard's step state snapshots):
+    fused+tier produces the same alert stream as sharded+tier."""
+    monkeypatch.setenv("THEIA_STATE_TIER", "1")
+    dbs, dbf = FlowDatabase(), FlowDatabase()
+    im_s = IngestManagerFactory(dbs, n_shards=2, streaming_capacity=16,
+                                engine="sharded")
+    im_f = IngestManagerFactory(dbf, n_shards=2, streaming_capacity=16,
+                                engine="fused")
+    try:
+        assert im_f._tiers and im_s._tiers
+        for seed in range(6):
+            b = _flow_batch(120, n_flows=20, seed=seed,
+                            offset=10 * (seed % 4))
+            hs, cs, ns = im_s.score_batch(b)
+            hf, cf, nf = im_f.score_batch(b)
+            assert ns == nf
+
+            def strip(conn):
+                return sorted(
+                    tuple(sorted((k, v) for k, v in d.items()
+                                 if k != "latency_s"))
+                    for d in conn)
+            assert strip(cs) == strip(cf)
+        assert sum(t.evictions for t in im_f._tiers) > 0
+        for s in im_f.shards:
+            assert s.streaming.dropped_series == 0
+    finally:
+        im_f.close()
+        im_s.close()
+
+
+def test_key_hash_stability():
+    """keyHash is a pure function of the resolved string tuple — the
+    restart-stable identity the recovery path depends on."""
+    t = ("10.0.0.1", 1234, "10.1.0.1", 80, 6, 1)
+    assert key_hash(t) == key_hash(tuple(t))
+    assert key_hash(t) != key_hash(("10.0.0.2",) + t[1:])
+    assert np.int64(key_hash(t))  # fits int64

@@ -1513,6 +1513,37 @@ def top(args) -> None:
                       f"steps/s, "
                       f"{step_rows / steps if steps > 0 else 0.0:,.0f}"
                       f" rows/step")
+            hot = sample.get(("theia_state_hot_series", ()))
+            if hot is not None:
+                # working-set state tier header: occupancy split plus
+                # promote/evict/drop rates from scrape-to-scrape
+                # deltas (drops must stay 0 while the tier is on —
+                # that is the tier's whole contract)
+                def _sdelta(name):
+                    if prev is None:
+                        return 0.0
+                    cur = sum(v for (n, _l), v in sample.items()
+                              if n == name)
+                    old = sum(v for (n, _l), v in prev.items()
+                              if n == name)
+                    return max(cur - old, 0.0)
+                spilled = sample.get(
+                    ("theia_state_spilled_series", ()), 0.0)
+                dt_t = now - prev_t if prev is not None else 0.0
+                ev = _sdelta("theia_state_evictions_total")
+                pr = _sdelta("theia_state_promotions_total")
+                drops = _sdelta("theia_detector_series_dropped_total")
+                tline = (f"state tier: {hot:,.0f} hot, "
+                         f"{spilled:,.0f} spilled, "
+                         f"{pr / dt_t if dt_t > 0 else 0.0:,.1f} "
+                         f"promotions/s, "
+                         f"{ev / dt_t if dt_t > 0 else 0.0:,.1f} "
+                         f"evictions/s, "
+                         f"{drops / dt_t if dt_t > 0 else 0.0:,.1f} "
+                         f"drops/s")
+                if drops:
+                    tline += "  ** SERIES DROPPED despite tier"
+                print(tline)
             if rows:
                 _print_table(rows, ["METRIC", "LABELS", "RATE/s",
                                     "VALUE"])

@@ -158,14 +158,30 @@ def default_ingest_shards() -> int:
 #: selectable scoring engines (THEIA_DETECTOR_ENGINE): "sharded" is
 #: today's per-shard-lock path; "fused" is the device-resident
 #: coalescing pipeline (ingest/device_path.py) — a drop-in with the
-#: same alert semantics, kept opt-in until bench proves the win per
-#: host class
-DETECTOR_ENGINES = ("sharded", "fused")
+#: same alert semantics; "auto" resolves per backend at construction
+#: (fused on TPU/GPU, sharded on CPU-only — the PR-16 crossover
+#: measurement in docs/ingest.md)
+DETECTOR_ENGINES = ("sharded", "fused", "auto")
 
 
 def default_detector_engine() -> str:
     name = os.environ.get("THEIA_DETECTOR_ENGINE", "").strip().lower()
     return name or "sharded"
+
+
+def resolve_auto_engine() -> str:
+    """`auto` → concrete engine for this host: the fused single-
+    dispatch pipeline wins on accelerator backends, while CPU-only
+    hosts measure faster on the sharded per-lock path (448k vs 642k
+    rows/s detector-leg on the 2-core reference host — the crossover
+    docs/ingest.md records). Unprobeable backend resolves sharded:
+    the conservative engine is the one that cannot need a device."""
+    try:
+        import jax
+        backend = jax.default_backend()
+    except Exception:
+        return "sharded"
+    return "fused" if backend in ("tpu", "gpu") else "sharded"
 
 
 class StreamCapacityError(Exception):
@@ -249,15 +265,53 @@ class IngestManager:
                 f"unknown detector engine {engine!r} "
                 f"(THEIA_DETECTOR_ENGINE): expected one of "
                 f"{DETECTOR_ENGINES}")
+        self.engine_requested = engine
+        if engine == "auto":
+            engine = resolve_auto_engine()
+            logger.info("detector engine auto → %s", engine)
         self.engine_name = engine
         _stream_kwargs = ({"capacity": int(streaming_capacity)}
                           if streaming_capacity else {})
+        # Working-set state tier (THEIA_STATE_TIER=1,
+        # ingest/state_tier.py): per-shard three-tier state stores —
+        # slot overflow spills LRU state to DRAM + the `detstate`
+        # result table (durable through WAL/snapshot/resync) instead
+        # of permanently dropping new series. Constructed only for
+        # manager-owned detectors; injected instances keep whatever
+        # tiering their creator chose.
+        self._tiers: List = []
+        _tiers: List = []
+        if detector is None and streaming is None:
+            from ..ingest import state_tier as _state_tier
+            if _state_tier.enabled():
+                cfg = _state_tier.TierConfig.from_env()
+                table = getattr(db, "result_tables", {}) or {}
+                table = table.get(_state_tier.DETSTATE_TABLE)
+                cold = _state_tier.SpillStore.recover_cold_indexes(
+                    table, self.n_shards, self.shard_of_destination)
+                spilled = sum(len(c) for c in cold)
+                if spilled:
+                    logger.info(
+                        "state tier recovered %d spilled series from "
+                        "the %s table", spilled,
+                        _state_tier.DETSTATE_TABLE)
+                _tiers = [
+                    _state_tier.WorkingSetTier(
+                        cfg,
+                        store=(_state_tier.SpillStore(table)
+                               if table is not None else None),
+                        key_resolver=self._resolve_keys,
+                        cold_index=cold[i])
+                    for i in range(self.n_shards)]
+                self._tiers = _tiers
         self.shards: List[DetectorShard] = [
             DetectorShard(i,
                           detector if detector is not None
                           else HeavyHitterDetector(),
                           streaming if streaming is not None
-                          else StreamingDetector(**_stream_kwargs))
+                          else StreamingDetector(
+                              tier=_tiers[i] if _tiers else None,
+                              **_stream_kwargs))
             for i in range(self.n_shards)]
         # Last-published CMS total per shard: peers read these without
         # taking the owner's lock, so heavy-hitter shares measure an
@@ -348,6 +402,15 @@ class IngestManager:
                     "fusedQueue", self._fused.queue_depth,
                     env_int("THEIA_FUSED_QUEUE_HIGH", 0)
                     or self._fused.queue_capacity)
+            if self._tiers:
+                # Spill-tier occupancy as overload pressure: a spilled
+                # series costs DRAM + a promote on re-arrival, so an
+                # unbounded working set walks the brownout ladder
+                # before it walks the host into swap.
+                self.admission.add_signal(
+                    "stateSpill",
+                    lambda: sum(t.spilled_count for t in self._tiers),
+                    env_int("THEIA_STATE_SPILL_HIGH", 1_000_000))
         # -- cluster tier hooks (theia_tpu/cluster wires these) ------
         # Router: split decoded batches by owner node, forward remote
         # slices (role `peer` routing mesh).
@@ -1061,6 +1124,20 @@ class IngestManager:
             self._dst_shard = np.concatenate([self._dst_shard, fresh])
         return self._dst_shard
 
+    def _resolve_keys(self, keys: np.ndarray) -> List[Tuple]:
+        """String-resolve [K, 6] ingest-global connection-key rows for
+        the state tier's restart-stable identity (keyHash + detstate
+        rows). Called under the shard lock / fused scorer thread with
+        K = keys being spilled or cold-probed, never per row. Takes
+        the dictionary lock only (same shard→dict edge as _remap's
+        callers; no reverse edge exists)."""
+        with self._dict_lock:
+            src_d = self._global_dicts["sourceIP"]
+            dst_d = self._global_dicts["destinationIP"]
+            return [(src_d.decode_one(int(k[0])), int(k[1]),
+                     dst_d.decode_one(int(k[2])), int(k[3]),
+                     int(k[4]), int(k[5])) for k in keys]
+
     def shard_of_destination(self, destination: str) -> int:
         """Stable shard assignment for a destination string (crc32 of
         the UTF-8 bytes mod n_shards — identical across processes,
@@ -1088,13 +1165,16 @@ class IngestManager:
 
     def detector_stats(self) -> Dict[str, object]:
         """Operator view of the sharded detector ensemble."""
-        return {
+        out = {
             "shards": self.n_shards,
             "series": [s.streaming.n_series for s in self.shards],
             "droppedSeries": [s.streaming.dropped_series
                               for s in self.shards],
             "totalVolume": float(self._shard_totals.sum()),
         }
+        if self._tiers:
+            out["stateTier"] = [t.stats() for t in self._tiers]
+        return out
 
     def shard_liveness(self) -> Dict[str, object]:
         """Health-surface view of the detector shards: per-shard series
@@ -1106,14 +1186,21 @@ class IngestManager:
             acquired = s.lock.acquire(blocking=False)
             if acquired:
                 s.lock.release()
-            per_shard.append({
+            row = {
                 "shard": s.index,
                 "busy": not acquired,
                 "series": int(s.streaming.n_series),
                 "capacity": int(s.streaming.capacity),
                 "droppedSeries": int(s.streaming.dropped_series),
-            })
+            }
+            if s.streaming.tier is not None:
+                row["stateTier"] = s.streaming.tier.stats()
+            per_shard.append(row)
         engine: Dict[str, object] = {"name": self.engine_name}
+        if self.engine_requested != self.engine_name:
+            # only informative when auto resolved the name
+            engine["requested"] = self.engine_requested
+
         if self._fused is not None:
             engine.update(self._fused.stats())
         return {

@@ -203,6 +203,32 @@ def fused_step(states: Tuple[ShardStepState, ...],
     return tuple(p[0] for p in pairs), tuple(p[1] for p in pairs)
 
 
+@jax.jit
+def gather_state(state: StreamState, slots: jnp.ndarray) -> StreamState:
+    """Pull `slots` rows of per-connection state off the device in ONE
+    dispatch — the working-set tier's eviction read
+    (ingest/state_tier.py). Padding slots carry `capacity`; the gather
+    clamps them to the last row (XLA OOB semantics) and the caller
+    slices them away."""
+    return StreamState(*(a[slots] for a in state))
+
+
+@jax.jit
+def restore_state(state: StreamState, slots: jnp.ndarray,
+                  ewma: jnp.ndarray, count: jnp.ndarray,
+                  mean: jnp.ndarray, m2: jnp.ndarray) -> StreamState:
+    """Scatter promoted / freshly-zeroed state rows into `slots` in ONE
+    dispatch — the working-set tier's promotion write. Padding slots
+    carry `capacity`, which the scatter DROPS (XLA OOB semantics), so
+    every eviction-batch size shares a handful of compiled shapes.
+    Zero rows double as slot re-initialization: a reused slot must not
+    leak its previous occupant's state."""
+    part = (ewma, count, mean, m2)
+    return StreamState(*(
+        full.at[slots].set(p.astype(full.dtype), mode="drop")
+        for full, p in zip(state, part)))
+
+
 def pallas_mode() -> Tuple[bool, bool]:
     """(use_pallas, interpret) from THEIA_FUSED_PALLAS:
     'auto' (default) enables the Pallas scan on TPU backends only;

@@ -10,6 +10,7 @@ from .flow_schema import (  # noqa: F401
     DROPDETECTION_SCHEMA,
     FLOWPATTERNS_SCHEMA,
     SPATIALNOISE_SCHEMA,
+    DETSTATE_SCHEMA,
     METRICS_SCHEMA,
     METRICS_TABLE,
     METRICS_VALUE_SCALE,

@@ -228,6 +228,29 @@ SPATIALNOISE_SCHEMA: tuple = _cols(
     ("octetDeltaCount", K.U64),
 )
 
+# Durable (cold) tier of the detector's flow-state working-set store
+# (ingest/state_tier.py): one row per spilled connection series, the
+# StreamState fields plus a restart-stable identity. The connection
+# 6-tuple is stored with STRING IPs — dictionary codes are not stable
+# across restarts — and `keyHash` (64-bit BLAKE2b of the resolved
+# tuple) is the recovery index key; `seq` disambiguates re-spills of
+# the same key (latest wins on read, older rows are prunable).
+DETSTATE_SCHEMA: tuple = _cols(
+    ("sourceIP", K.STRING),
+    ("destinationIP", K.STRING),
+    ("sourceTransportPort", K.U16),
+    ("destinationTransportPort", K.U16),
+    ("protocolIdentifier", K.U16),
+    ("flowStartSeconds", K.DATETIME),
+    ("ewma", K.F64),
+    ("mean", K.F64),
+    ("m2", K.F64),
+    ("count", K.U64),
+    ("seq", K.U64),
+    ("keyHash", K.U64),
+    ("timeSpilled", K.DATETIME),
+)
+
 #: the one authoritative name of the self-scraped metrics history
 #: table — the store registers it, the planner resolves it, and the
 #: scrape loop writes it, all from this constant

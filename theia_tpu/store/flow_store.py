@@ -31,6 +31,7 @@ from typing import Callable, Dict, List, Mapping, Optional, Sequence, Tuple
 import numpy as np
 
 from ..schema import (
+    DETSTATE_SCHEMA,
     DROPDETECTION_SCHEMA,
     FLOW_SCHEMA,
     FLOWPATTERNS_SCHEMA,
@@ -55,6 +56,10 @@ RESULT_TABLE_SCHEMAS = (
     ("dropdetection", DROPDETECTION_SCHEMA),
     ("flowpatterns", FLOWPATTERNS_SCHEMA),
     ("spatialnoise", SPATIALNOISE_SCHEMA),
+    # detector working-set spill state (ingest/state_tier.py) — riding
+    # this list is what makes spilled flow state survive kill -9,
+    # failover, and resync through the standard planes
+    ("detstate", DETSTATE_SCHEMA),
     (METRICS_TABLE, METRICS_SCHEMA),
 )
 from ..obs import metrics as _metrics

@@ -62,6 +62,15 @@ Instrumented sites:
                       the request inside admission. Combine with
                       THEIA_ADMISSION_FORCE_LEVEL=<rung> to pin any
                       brownout rung instead of just the reject rung.
+    state.spill       working-set tier eviction (ingest/state_tier.py),
+                      before any gather/encode/insert — an injected
+                      error fails the micro-batch with hot state fully
+                      intact, so the retry re-runs the identical spill
+    state.promote     working-set tier promotion of re-arriving spilled
+                      series, before any warm/cold state is consumed
+    state.age_out     warm-block aging to the cold (store-only) tier;
+                      an injected error defers the maintenance round —
+                      never fails the batch
 
 Modes: "error" raises FaultError (callers treat it like any I/O
 error); "hang" sleeps THEIA_FAULT_HANG_SECONDS (default 3600 — long
@@ -108,6 +117,9 @@ KNOWN_SITES = (
     "admission.pressure",
     "wire.decode",
     "wire.gather",
+    "state.spill",
+    "state.promote",
+    "state.age_out",
 )
 
 _M_FIRINGS = _metrics.counter(
